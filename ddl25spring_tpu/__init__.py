@@ -1,0 +1,27 @@
+"""ddl25spring_tpu — a TPU-native distributed deep learning framework.
+
+A from-scratch JAX/XLA re-design of the capability surface of the DDL25Spring
+course stack (see SURVEY.md at the repo root). Instead of rank-conditional
+Python processes wired with gloo sockets (reference: lab/tutorial_1b/**), every
+workload here is a single SPMD program over a named `jax.sharding.Mesh`:
+
+- data parallelism      -> `shard_map` over a ``data`` axis + ``lax.psum``
+- pipeline parallelism  -> a ``stage`` axis with ``lax.ppermute`` hops
+- tensor parallelism    -> sharded matmuls over a ``model`` axis
+- sequence parallelism  -> ring attention over a ``seq`` axis
+- federated learning    -> a vmapped/sharded ``client`` axis; aggregation rules
+  (FedAvg, Krum, median, ...) are pure functions over that axis.
+
+Subpackages:
+  config    — dataclass configs carrying the reference's default hyperparameters
+  rng       — seed discipline (per-(client, round) determinism)
+  metrics   — RunResult records and evaluation metrics
+  data      — MNIST / tabular / token-stream pipelines (offline-capable)
+  tokenizers— self-contained SentencePiece unigram model reader/encoder
+  models    — functional model zoo (tiny-Llama, MnistCnn, MLPs, VAE, VFL nets)
+  ops       — losses, attention, collective helpers, Pallas kernels
+  parallel  — DP / PP / TP / SP strategies and the FL client/server suite
+  utils     — pytree helpers, timing, checkpointing, logging
+"""
+
+__version__ = "0.1.0"

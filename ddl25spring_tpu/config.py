@@ -1,0 +1,133 @@
+"""Workload configuration dataclasses.
+
+The reference has no flag system: hyperparameters live as module constants and
+homework-text defaults (reference: lab/tutorial_1b/primer/intro.py:7-23 for the
+tiny-Llama constants; lab/homework-1.ipynb cell 5 for the FL defaults N=100,
+lr=0.01, C=0.1, E=1, B=100, rounds=10, iid=True, seed=10). Here each workload
+gets one frozen dataclass whose *defaults are the reference's parity configs*,
+so `FLConfig()` with no arguments reproduces the homework setting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class FLConfig:
+    """Horizontal federated learning (FedSGD / FedAvg) configuration.
+
+    Defaults mirror the homework-1 defaults (reference: lab/homework-1.ipynb
+    cell 5 and lab/tutorial_1a/hfl_complete.py:256-386).
+    """
+
+    nr_clients: int = 100          # N
+    client_fraction: float = 0.1   # C — fraction of clients sampled per round
+    batch_size: int = 100          # B — -1 means full local dataset (∞)
+    epochs: int = 1                # E — local epochs per round (FedAvg)
+    lr: float = 0.01               # η
+    rounds: int = 10
+    iid: bool = True
+    seed: int = 10
+
+    @property
+    def clients_per_round(self) -> int:
+        # max(1, C·N) like the reference's client sampling.
+        return max(1, int(self.client_fraction * self.nr_clients))
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    """tiny-Llama model configuration.
+
+    Defaults are the canonical config used by every reference LLM experiment
+    (reference: lab/tutorial_1b/primer/intro.py:7-10 — dmodel=288, 6 heads,
+    6 layers, seq 256; Adam lr 8e-4 at intro.py:22).
+    """
+
+    vocab_size: int = 32000
+    dmodel: int = 288
+    num_heads: int = 6
+    n_layers: int = 6
+    ctx_size: int = 256
+    ffn_hidden: Optional[int] = None   # None -> 4 * dmodel (SwiGLU-gated)
+    padding_idx: Optional[int] = None
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: str = "float32"             # computation dtype ("bfloat16" on TPU)
+    param_dtype: str = "float32"
+    # Attention backend: "xla" (einsum softmax) or "pallas" (fused flash kernel).
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dmodel % self.num_heads == 0
+        return self.dmodel // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.ffn_hidden if self.ffn_hidden is not None else 4 * self.dmodel
+
+    def replace(self, **kw) -> "LlamaConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """LLM training loop configuration (reference: primer/intro.py:22-23 —
+    Adam lr 8e-4, 5000 iterations, batch 3 per rank, seq 256)."""
+
+    batch_size: int = 3            # per-data-shard batch (reference: per-rank)
+    seq_len: int = 256
+    lr: float = 8e-4
+    iters: int = 5000
+    seed: int = 0
+    # Mesh layout: named axis sizes. 1 disables that axis.
+    data: int = 1
+    stage: int = 1                 # pipeline stages
+    model: int = 1                 # tensor parallel degree
+    seq: int = 1                   # sequence/context parallel degree
+    microbatches: int = 1          # GPipe microbatches per step (PP)
+    remat: bool = False            # jax.checkpoint on transformer blocks
+
+
+@dataclass(frozen=True)
+class VFLConfig:
+    """Vertical FL / split learning configuration (reference:
+    lab/tutorial_2b/vfl.py:159-168 — 4 clients, 300 epochs, batch 64)."""
+
+    nr_clients: int = 4
+    epochs: int = 300
+    batch_size: int = 64
+    lr: float = 1e-3
+    bottom_out_dim: int = 2        # per-client bottom model output width
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class VAEConfig:
+    """Tabular VAE configuration (reference: lab/tutorial_2a/
+    generative-modeling.py:13-116 — input 13, latent dim 3, BN-MLP stack)."""
+
+    input_dim: int = 13
+    hidden_dims: Tuple[int, ...] = (50, 12)
+    latent_dim: int = 3
+    lr: float = 1e-3
+    epochs: int = 200
+    batch_size: int = 64
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class AttackConfig:
+    """Byzantine adversary injection (reference: lab/tutorial_3/
+    attacks_and_defenses.ipynb cell 9 — 20% malicious, and the hw03 sweep
+    setting lr=0.02, B=200, C=0.2, E=2, seed 42)."""
+
+    malicious_fraction: float = 0.2
+    attack: str = "gradient_reversion"
+    scale: float = 5.0             # the -5x / 5x / 2x update scaling knobs
+    backdoor_label: int = 0
+    seed: int = 42

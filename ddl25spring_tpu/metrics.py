@@ -1,0 +1,106 @@
+"""Experiment records and metrics.
+
+`RunResult` mirrors the reference's result record (reference:
+lab/tutorial_1a/hfl_complete.py:113-138): algorithm name, N/C/B/E/η/seed, and
+per-round wall time, cumulative message count, and test accuracy, with a
+pandas rendering that displays η and B=-1 as ∞. The message-count model is the
+reference's ``2·(round+1)·clients_per_round`` (hfl_complete.py:383) — one
+down + one up message per sampled client per round, cumulative.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class RunResult:
+    algorithm: str
+    nr_clients: int                # N
+    client_fraction: float         # C
+    batch_size: int                # B (-1 ⇒ ∞)
+    epochs: int                    # E
+    lr: float                      # η
+    seed: int
+    wall_time: List[float] = field(default_factory=list)
+    message_count: List[int] = field(default_factory=list)
+    test_accuracy: List[float] = field(default_factory=list)
+
+    def record_round(self, wall_time: float, message_count: int, test_accuracy: float) -> None:
+        self.wall_time.append(float(wall_time))
+        self.message_count.append(int(message_count))
+        self.test_accuracy.append(float(test_accuracy))
+
+    @property
+    def rounds(self) -> int:
+        return len(self.test_accuracy)
+
+    def as_df(self):
+        """Pandas rendering with the reference's display conventions
+        (hfl_complete.py:124-138: unicode η column, B=-1 shown as ∞)."""
+        import pandas as pd
+
+        b = "∞" if self.batch_size == -1 else self.batch_size
+        return pd.DataFrame(
+            {
+                "algorithm": self.algorithm,
+                "N": self.nr_clients,
+                "C": self.client_fraction,
+                "B": b,
+                "E": self.epochs,
+                "η": self.lr,
+                "seed": self.seed,
+                "round": np.arange(1, self.rounds + 1),
+                "wall_time": np.asarray(self.wall_time),
+                "message_count": np.asarray(self.message_count),
+                "test_accuracy": np.asarray(self.test_accuracy),
+            }
+        )
+
+
+def message_count(round_idx: int, clients_per_round: int) -> int:
+    """Cumulative messages after round ``round_idx`` (0-based):
+    ``2·(round+1)·m`` (reference: hfl_complete.py:383)."""
+    return 2 * (round_idx + 1) * clients_per_round
+
+
+def accuracy(logits: np.ndarray, labels: np.ndarray) -> float:
+    """Top-1 accuracy."""
+    return float((np.asarray(logits).argmax(-1) == np.asarray(labels)).mean())
+
+
+def confusion_matrix(predictions: np.ndarray, labels: np.ndarray, num_classes: int) -> np.ndarray:
+    """Class-wise confusion matrix, rows = true label, cols = prediction
+    (reference: attacks_and_defenses.ipynb cell 17 `get_conf_maf`)."""
+    predictions = np.asarray(predictions).reshape(-1)
+    labels = np.asarray(labels).reshape(-1)
+    cm = np.zeros((num_classes, num_classes), dtype=np.int64)
+    np.add.at(cm, (labels, predictions), 1)
+    return cm
+
+
+def backdoor_metrics(
+    clean_predictions: np.ndarray,
+    clean_labels: np.ndarray,
+    triggered_predictions: np.ndarray,
+    backdoor_label: int,
+) -> tuple:
+    """(clean accuracy, attack success rate).
+
+    ASR = fraction of the fully-triggered test set classified as the backdoor
+    label (reference: attacks_and_defenses.ipynb cell 30
+    `confusion_matrix_backdoor`). Samples whose true label already equals the
+    backdoor label are excluded from the ASR denominator.
+    """
+    clean_predictions = np.asarray(clean_predictions)
+    clean_labels = np.asarray(clean_labels)
+    triggered_predictions = np.asarray(triggered_predictions)
+    clean_acc = float((clean_predictions == clean_labels).mean())
+    mask = clean_labels != backdoor_label
+    if not mask.any():  # degenerate test set: every true label is the backdoor label
+        return clean_acc, 0.0
+    asr = float((triggered_predictions[mask] == backdoor_label).mean())
+    return clean_acc, asr

@@ -1,0 +1,79 @@
+"""Pytree helpers.
+
+The reference flattens all model gradients into one contiguous CPU tensor for
+its allreduce bucket (reference: lab/tutorial_1b/DP/gradient_aggr/
+intro_DP_GA.py:55-66) and the Byzantine defenses operate on flat update
+vectors (attacks_and_defenses.ipynb cell 34). Here those become pure pytree ↔
+flat-vector transforms that are jit/vmap friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+PyTree = Any
+
+
+def flatten(tree: PyTree) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], PyTree]]:
+    """Pytree -> (flat vector, unflatten fn)."""
+    return ravel_pytree(tree)
+
+
+def tree_add(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: PyTree, s) -> PyTree:
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_zeros_like(tree: PyTree) -> PyTree:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_weighted_sum(trees: PyTree, weights: jnp.ndarray) -> PyTree:
+    """Weighted sum over a leading stacked axis: each leaf has shape
+    [n, ...]; returns Σ_i w_i · leaf_i. This is the FedAvg aggregation
+    (reference: hfl_complete.py:366-374) as a pure reduction."""
+    def leaf(x):
+        w = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        return (x * w).sum(axis=0)
+
+    return jax.tree.map(leaf, trees)
+
+
+def tree_stack(trees) -> PyTree:
+    """List of pytrees -> single pytree with leading stacked axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(tree: PyTree):
+    """Inverse of tree_stack: pytree with leading axis n -> list of n pytrees."""
+    leaves, treedef = jax.tree.flatten(tree)
+    n = leaves[0].shape[0]
+    return [jax.tree.unflatten(treedef, [leaf[i] for leaf in leaves]) for i in range(n)]
+
+
+def tree_index(tree: PyTree, i) -> PyTree:
+    """Select index ``i`` along every leaf's leading axis (jit-safe)."""
+    return jax.tree.map(lambda x: x[i], tree)
+
+
+def param_count(tree: PyTree) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+
+def global_norm(tree: PyTree) -> jnp.ndarray:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(tree)))
+
+
+def tree_cast(tree: PyTree, dtype) -> PyTree:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)

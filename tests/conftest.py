@@ -1,0 +1,30 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This reproduces the reference's "multi-node without a cluster" trick
+(reference: lab/hw01/homework 1 b/homework_1_b1.sh spawns N localhost gloo
+processes) in-process: XLA fakes 8 host devices, so every shard_map/pjit
+code path exercises real multi-device partitioning and collectives.
+
+The env vars MUST be set before jax is imported anywhere.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The container's sitecustomize imports jax with JAX_PLATFORMS=axon (TPU) at
+# interpreter start, so env vars alone are too late — override via config,
+# which takes effect because no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs

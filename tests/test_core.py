@@ -1,0 +1,88 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu import config, metrics, rng
+from ddl25spring_tpu.utils import pytree as pt
+
+
+def test_fl_config_defaults_match_reference():
+    c = config.FLConfig()
+    assert (c.nr_clients, c.client_fraction, c.batch_size, c.epochs) == (100, 0.1, 100, 1)
+    assert (c.lr, c.rounds, c.iid, c.seed) == (0.01, 10, True, 10)
+    assert c.clients_per_round == 10
+
+
+def test_llama_config_defaults_match_reference():
+    c = config.LlamaConfig()
+    assert (c.dmodel, c.num_heads, c.n_layers, c.ctx_size) == (288, 6, 6, 256)
+    assert c.head_dim == 48
+
+
+def test_per_client_seed_formula():
+    # reference: hfl_complete.py:364 — seed + ind + 1 + round * m
+    assert rng.per_client_seed(10, 0, 0, 10) == 11
+    assert rng.per_client_seed(10, 3, 7, 10) == 10 + 7 + 1 + 30
+
+
+def test_client_sampling_reproducible_without_replacement():
+    a = rng.sample_clients(42, 5, nr_clients=100, nr_per_round=20)
+    b = rng.sample_clients(42, 5, nr_clients=100, nr_per_round=20)
+    assert np.array_equal(a, b)
+    assert len(np.unique(np.asarray(a))) == 20
+    c = rng.sample_clients(42, 6, nr_clients=100, nr_per_round=20)
+    assert not np.array_equal(a, c)
+
+
+def test_message_count_model():
+    # reference model: 2·(round+1)·m, cumulative (hfl_complete.py:383)
+    assert [metrics.message_count(r, 10) for r in range(3)] == [20, 40, 60]
+
+
+def test_run_result_as_df():
+    r = metrics.RunResult("fedavg", 100, 0.1, -1, 1, 0.01, 10)
+    r.record_round(1.5, 20, 0.5)
+    df = r.as_df()
+    assert df["B"].iloc[0] == "∞"
+    assert df["test_accuracy"].iloc[0] == 0.5
+
+
+def test_confusion_and_backdoor_metrics():
+    cm = metrics.confusion_matrix(np.array([0, 1, 1]), np.array([0, 1, 0]), 3)
+    assert cm[0, 0] == 1 and cm[1, 1] == 1 and cm[0, 1] == 1
+    clean_acc, asr = metrics.backdoor_metrics(
+        clean_predictions=np.array([0, 1, 2, 3]),
+        clean_labels=np.array([0, 1, 2, 3]),
+        triggered_predictions=np.array([0, 0, 0, 3]),
+        backdoor_label=0,
+    )
+    assert clean_acc == 1.0
+    assert asr == pytest.approx(2 / 3)
+
+
+def test_pytree_flatten_roundtrip():
+    tree = {"a": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(4)}
+    flat, unflatten = pt.flatten(tree)
+    assert flat.shape == (10,)
+    back = unflatten(flat)
+    assert jnp.allclose(back["a"], tree["a"]) and jnp.allclose(back["b"], tree["b"])
+
+
+def test_tree_weighted_sum_matches_manual():
+    trees = pt.tree_stack([{"w": jnp.full((2,), float(i))} for i in range(3)])
+    out = pt.tree_weighted_sum(trees, jnp.array([0.2, 0.3, 0.5]))
+    assert jnp.allclose(out["w"], jnp.full((2,), 0.3 + 1.0))
+
+
+def test_tree_stack_unstack_index():
+    trees = [{"w": jnp.array([i, i])} for i in range(4)]
+    stacked = pt.tree_stack(trees)
+    assert stacked["w"].shape == (4, 2)
+    assert jnp.array_equal(pt.tree_index(stacked, 2)["w"], jnp.array([2, 2]))
+    back = pt.tree_unstack(stacked)
+    assert len(back) == 4 and jnp.array_equal(back[3]["w"], jnp.array([3, 3]))
+
+
+def test_eight_virtual_devices(devices):
+    assert len(devices) == 8
