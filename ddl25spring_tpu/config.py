@@ -60,6 +60,9 @@ class LlamaConfig:
     param_dtype: str = "float32"
     # Attention backend: "xla" (einsum softmax) or "pallas" (fused flash kernel).
     attention_impl: str = "xla"
+    # Rematerialize block activations in backward (jax.checkpoint) — trades
+    # FLOPs for HBM, the TPU-native answer to activation memory pressure.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:

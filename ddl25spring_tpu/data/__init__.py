@@ -1,0 +1,1 @@
+from . import mnist, tabular, tokens  # noqa: F401

@@ -1,0 +1,1 @@
+from . import llama, mnist_cnn, tabular, vae, vfl_nets  # noqa: F401

@@ -1,0 +1,249 @@
+"""tiny-Llama: a functional causal transformer, designed for TPU parallelism.
+
+Capability target (NOT a port): the ``simplellm`` Llama family the reference
+trains everywhere — full model `LLama(...)`, plus the pipeline-stage variants
+`LLamaFirstStage` (with a separate ``.embed``), `LLamaStage` (hidden→hidden),
+and `LLamaLastStage` (hidden→logits); canonical config dmodel=288, 6 heads,
+6 layers, ctx 256 (reference: lab/tutorial_1b/primer/intro.py:7-18,
+lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py:29-39).
+
+TPU-first design decisions:
+- Transformer blocks are *stacked*: every block parameter has a leading
+  ``[n_layers, ...]`` axis and the forward pass is a single ``lax.scan`` —
+  one compiled block body regardless of depth, which keeps compile time flat
+  and makes pipeline-stage splitting a pure array slice on the leading axis
+  (`split_stages` / `stage_apply`).
+- Pre-norm RMSNorm + RoPE + SwiGLU MLP (Llama conventions).
+- dtype-parameterized: params in fp32, activations typically bf16 so matmuls
+  land on the MXU at full rate.
+- No data-dependent Python control flow: jit/scan end-to-end.
+- Attention is pluggable: "xla" einsum-softmax (XLA fuses it well) or the
+  Pallas flash kernel (ops.flash_attention) once seq lengths warrant it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import LlamaConfig
+from .. import nn
+
+
+# ------------------------------------------------------------------ init
+
+def _normal(key, shape, std, dtype):
+    return jax.random.normal(key, shape, dtype) * jnp.asarray(std, dtype)
+
+
+def init_block(key, cfg: LlamaConfig) -> dict:
+    """One transformer block's parameters (un-stacked)."""
+    dt = jnp.dtype(cfg.param_dtype)
+    d, f = cfg.dmodel, cfg.ffn_dim
+    ks = jax.random.split(key, 7)
+    std = 0.02
+    # Residual-out projections scaled down by sqrt(2·L) (GPT-2/Llama init).
+    out_std = 0.02 / math.sqrt(2 * cfg.n_layers)
+    return {
+        "attn_norm": nn.rmsnorm_init(d, dt),
+        "wq": _normal(ks[0], (d, d), std, dt),
+        "wk": _normal(ks[1], (d, d), std, dt),
+        "wv": _normal(ks[2], (d, d), std, dt),
+        "wo": _normal(ks[3], (d, d), out_std, dt),
+        "mlp_norm": nn.rmsnorm_init(d, dt),
+        "w_gate": _normal(ks[4], (d, f), std, dt),
+        "w_up": _normal(ks[5], (d, f), std, dt),
+        "w_down": _normal(ks[6], (f, d), out_std, dt),
+    }
+
+
+def init_llama(key, cfg: LlamaConfig) -> dict:
+    """Full model parameters.
+
+    Structure: {"embed": [V, D], "blocks": pytree with leading [L] axis,
+    "final_norm": ..., "lm_head": [D, V]} — the leading block axis is what
+    `split_stages` slices for pipeline parallelism.
+    """
+    dt = jnp.dtype(cfg.param_dtype)
+    k_embed, k_blocks, k_head = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, cfg.n_layers)
+    blocks = jax.vmap(lambda k: init_block(k, cfg))(block_keys)
+    embed = _normal(k_embed, (cfg.vocab_size, cfg.dmodel), 0.02, dt)
+    if cfg.padding_idx is not None:
+        embed = embed.at[cfg.padding_idx].set(0.0)
+    return {
+        "embed": embed,
+        "blocks": blocks,
+        "final_norm": nn.rmsnorm_init(cfg.dmodel, dt),
+        "lm_head": _normal(k_head, (cfg.dmodel, cfg.vocab_size), 0.02, dt),
+    }
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+# ------------------------------------------------------------------ RoPE
+
+def rope_angles(positions: jnp.ndarray, head_dim: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for rotary embeddings. positions: [T] (absolute), so
+    sequence-parallel shards pass their global offsets and stay correct."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]   # [T, half]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, T, H, Dh]; rotate pairs (x1, x2) = (x[..., :half], x[..., half:])."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
+# ------------------------------------------------------------------ attention
+
+def _xla_attention(q, k, v, *, causal: bool, q_offset: int = 0) -> jnp.ndarray:
+    """[B, T, H, Dh] attention with fp32 softmax. q_offset shifts the causal
+    mask for sequence-parallel query shards."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = jnp.arange(tq)[:, None] + q_offset
+        kpos = jnp.arange(tk)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
+              cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    b, t, d = x.shape
+    h, dh = cfg.num_heads, cfg.head_dim
+    q = (x @ block["wq"].astype(x.dtype)).reshape(b, t, h, dh)
+    k = (x @ block["wk"].astype(x.dtype)).reshape(b, t, h, dh)
+    v = (x @ block["wv"].astype(x.dtype)).reshape(b, t, h, dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if cfg.attention_impl == "pallas":
+        from ..ops.flash_attention import flash_attention
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = _xla_attention(q, k, v, causal=True)
+    return out.reshape(b, t, d) @ block["wo"].astype(x.dtype)
+
+
+def mlp(block: dict, x: jnp.ndarray) -> jnp.ndarray:
+    gate = jax.nn.silu(x @ block["w_gate"].astype(x.dtype))
+    up = x @ block["w_up"].astype(x.dtype)
+    return (gate * up) @ block["w_down"].astype(x.dtype)
+
+
+def block_apply(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
+                cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    x = x + attention(block, nn.rmsnorm(block["attn_norm"], x, eps=cfg.norm_eps), cfg, cos, sin)
+    x = x + mlp(block, nn.rmsnorm(block["mlp_norm"], x, eps=cfg.norm_eps))
+    return x
+
+
+# ------------------------------------------------------------------ stages
+# These four functions are the framework's equivalent of simplellm's
+# LLamaFirstStage.embed / LLamaStage / LLamaLastStage surface
+# (reference: intro_PP_1F1B.py:29-39,53).
+
+def embed(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """tokens [B, T] -> activations [B, T, D] in the compute dtype.
+
+    With ``padding_idx`` set, pad positions produce zero vectors AND the pad
+    row receives no gradient (torch Embedding(padding_idx) semantics — the
+    masked output cuts the backward path to that row).
+    """
+    h = params["embed"][tokens]
+    if cfg.padding_idx is not None:
+        h = jnp.where((tokens == cfg.padding_idx)[..., None], 0.0, h)
+    return h.astype(jnp.dtype(cfg.dtype))
+
+
+def blocks_apply(blocks: dict, h: jnp.ndarray, cfg: LlamaConfig,
+                 positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Apply a stack of blocks (leading [L] axis) via one lax.scan."""
+    t = h.shape[1]
+    if positions is None:
+        positions = jnp.arange(t)
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    fn = jax.checkpoint(block_apply, static_argnums=(2,)) if cfg.remat else block_apply
+
+    def body(carry, block):
+        return fn(block, carry, cfg, cos, sin), None
+
+    out, _ = lax.scan(body, h, blocks)
+    return out
+
+
+def head(params: dict, h: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """activations [B, T, D] -> logits [B, T, V] (fp32 for a stable loss)."""
+    h = nn.rmsnorm(params["final_norm"], h, eps=cfg.norm_eps)
+    return (h @ params["lm_head"].astype(h.dtype)).astype(jnp.float32)
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Full causal LM: tokens [B, T] -> logits [B, T, V]."""
+    h = embed(params, tokens, cfg)
+    h = blocks_apply(params["blocks"], h, cfg, positions)
+    return head(params, h, cfg)
+
+
+# ------------------------------------------------------------------ pipeline splitting
+
+def split_stages(params: dict, n_stages: int) -> list:
+    """Slice the stacked block axis into ``n_stages`` contiguous stage params.
+
+    Stage 0 carries the embedding, the last stage carries final_norm+lm_head —
+    mirroring the First/Stage/Last decomposition of the reference's pipeline
+    (intro_PP_1F1B.py:29-39) as pure array slicing.
+    """
+    n_layers = jax.tree.leaves(params["blocks"])[0].shape[0]
+    assert n_layers % n_stages == 0, (n_layers, n_stages)
+    per = n_layers // n_stages
+    stages = []
+    for s in range(n_stages):
+        stage = {"blocks": jax.tree.map(lambda x: x[s * per:(s + 1) * per], params["blocks"])}
+        if s == 0:
+            stage["embed"] = params["embed"]
+        if s == n_stages - 1:
+            stage["final_norm"] = params["final_norm"]
+            stage["lm_head"] = params["lm_head"]
+        stages.append(stage)
+    return stages
+
+
+def merge_stages(stages: list) -> dict:
+    """Inverse of split_stages."""
+    blocks = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0),
+                          *[s["blocks"] for s in stages])
+    return {
+        "embed": stages[0]["embed"],
+        "blocks": blocks,
+        "final_norm": stages[-1]["final_norm"],
+        "lm_head": stages[-1]["lm_head"],
+    }
+
+
+def stage_apply(stage: dict, x: jnp.ndarray, cfg: LlamaConfig, *,
+                is_first: bool, is_last: bool,
+                positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Run one pipeline stage: embeds if first, heads if last.
+
+    x is tokens [B, T] for the first stage, activations [B, T, D] otherwise.
+    """
+    h = embed(stage, x, cfg) if is_first else x
+    h = blocks_apply(stage["blocks"], h, cfg, positions)
+    return head(stage, h, cfg) if is_last else h

@@ -1,0 +1,39 @@
+"""MNIST CNN — the HFL workhorse model.
+
+Capability target: the reference's `MnistCnn` (lab/tutorial_1a/
+hfl_complete.py:39-64), the model every FedSGD/FedAvg/attack/defense
+experiment trains. Standard two-conv CNN; inputs are NCHW [B, 1, 28, 28]
+normalized with the MNIST constants (0.1307, 0.3081) preserved by the data
+layer (hfl_complete.py:23).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+
+NUM_CLASSES = 10
+
+
+def init(key) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "conv1": nn.conv2d_init(k1, 1, 32, 3),
+        "conv2": nn.conv2d_init(k2, 32, 64, 3),
+        # 28 -> conv3 26 -> pool 13 -> conv3 11 -> pool 5; 64·5·5 = 1600
+        "fc1": nn.dense_init(k3, 64 * 5 * 5, 128),
+        "fc2": nn.dense_init(k4, 128, NUM_CLASSES),
+    }
+
+
+def apply(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, 1, 28, 28] -> logits [B, 10]."""
+    h = nn.relu(nn.conv2d(params["conv1"], x))
+    h = nn.max_pool2d(h)
+    h = nn.relu(nn.conv2d(params["conv2"], h))
+    h = nn.max_pool2d(h)
+    h = h.reshape(h.shape[0], -1)
+    h = nn.relu(nn.dense(params["fc1"], h))
+    return nn.dense(params["fc2"], h)

@@ -1,0 +1,26 @@
+"""Tabular classifier for the heart-disease task.
+
+Capability target: the reference's `HeartDiseaseNN` 4-layer MLP
+(lab/tutorial_2a/centralized.py:13-28) trained on heart.csv with
+best-state_dict-by-test-accuracy tracking (centralized.py:51,67-70).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+
+NUM_CLASSES = 2
+
+
+def init(key, in_dim: int = 13, hidden: Sequence[int] = (64, 32, 16)) -> list:
+    return nn.mlp_init(key, [in_dim, *hidden, NUM_CLASSES])
+
+
+def apply(params: list, x: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, in_dim] -> logits [B, 2]."""
+    return nn.mlp(params, x)

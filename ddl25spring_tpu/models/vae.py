@@ -1,0 +1,98 @@
+"""Tabular VAE for synthetic-data generation.
+
+Capability target: the reference's BatchNorm-MLP `Autoencoder` with
+encode/reparameterize/decode, the MSE+KLD `customLoss`, and `sample()` from
+N(0, I) (lab/tutorial_2a/generative-modeling.py:13-128), plus the
+synthetic-data evaluation protocol (train an evaluator on real vs synthetic,
+compare test accuracy — generative-modeling.py:165-209).
+
+Functional design: params + explicit BatchNorm running-state pytrees; the
+reparameterization trick takes a jax PRNG key. All pure — jit/vmap friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..config import VAEConfig
+
+
+def init(key, cfg: VAEConfig) -> Tuple[dict, dict]:
+    """Returns (params, state) — state holds BatchNorm running stats."""
+    dims = [cfg.input_dim, *cfg.hidden_dims]
+    keys = jax.random.split(key, 2 * len(cfg.hidden_dims) + 4)
+    ki = iter(keys)
+    params, state = {"enc": [], "dec": []}, {"enc": [], "dec": []}
+    for i in range(len(dims) - 1):
+        bn_p, bn_s = nn.batchnorm_init(dims[i + 1])
+        params["enc"].append({"lin": nn.dense_init(next(ki), dims[i], dims[i + 1]), "bn": bn_p})
+        state["enc"].append(bn_s)
+    params["mu"] = nn.dense_init(next(ki), dims[-1], cfg.latent_dim)
+    params["logvar"] = nn.dense_init(next(ki), dims[-1], cfg.latent_dim)
+    rdims = [cfg.latent_dim, *reversed(cfg.hidden_dims)]
+    for i in range(len(rdims) - 1):
+        bn_p, bn_s = nn.batchnorm_init(rdims[i + 1])
+        params["dec"].append({"lin": nn.dense_init(next(ki), rdims[i], rdims[i + 1]), "bn": bn_p})
+        state["dec"].append(bn_s)
+    params["out"] = nn.dense_init(next(ki), rdims[-1], cfg.input_dim)
+    return params, state
+
+
+def _stack(layers, states, x, *, train):
+    new_states = []
+    for layer, st in zip(layers, states):
+        x = nn.dense(layer["lin"], x)
+        x, st2 = nn.batchnorm(layer["bn"], st, x, train=train)
+        x = nn.relu(x)
+        new_states.append(st2)
+    return x, new_states
+
+
+def encode(params, state, x, *, train: bool):
+    h, enc_state = _stack(params["enc"], state["enc"], x, train=train)
+    mu = nn.dense(params["mu"], h)
+    logvar = nn.dense(params["logvar"], h)
+    return mu, logvar, {**state, "enc": enc_state}
+
+
+def reparameterize(key, mu, logvar):
+    std = jnp.exp(0.5 * logvar)
+    return mu + std * jax.random.normal(key, mu.shape, mu.dtype)
+
+
+def kl_divergence(mu, logvar) -> jnp.ndarray:
+    """Summed KL(q(z|x) || N(0, I)) — shared by the VAE and VFL-VAE losses."""
+    return -0.5 * jnp.sum(1 + logvar - jnp.square(mu) - jnp.exp(logvar))
+
+
+def decode(params, state, z, *, train: bool):
+    h, dec_state = _stack(params["dec"], state["dec"], z, train=train)
+    return nn.dense(params["out"], h), {**state, "dec": dec_state}
+
+
+def apply(params, state, x, key, *, train: bool):
+    """Full VAE pass: returns (recon, mu, logvar, new_state)."""
+    mu, logvar, state = encode(params, state, x, train=train)
+    z = reparameterize(key, mu, logvar) if train else mu
+    recon, state = decode(params, state, z, train=train)
+    return recon, mu, logvar, state
+
+
+def loss_fn(recon, x, mu, logvar) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """MSE(sum) + KLD, the reference's `customLoss`
+    (generative-modeling.py:119-128). Returns (total, mse, kld)."""
+    mse = jnp.sum(jnp.square(recon - x))
+    kld = kl_divergence(mu, logvar)
+    return mse + kld, mse, kld
+
+
+def sample(key, params, state, n: int, latent_dim: int):
+    """Draw n synthetic rows by decoding z ~ N(0, I) in eval mode
+    (generative-modeling.py sample())."""
+    z = jax.random.normal(key, (n, latent_dim))
+    out, _ = decode(params, state, z, train=False)
+    return out

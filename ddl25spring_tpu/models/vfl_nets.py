@@ -1,0 +1,99 @@
+"""Vertical-FL model stack: per-party bottom models + server top model.
+
+Capability target: the reference's `BottomModel` (per-client MLP over that
+client's feature slice), `TopModel` (classifier over concatenated bottom
+outputs), and `VFLNetwork` (lab/tutorial_2b/vfl.py:11-102), plus the VFL-VAE
+hybrid of hw2 ex3: client encoders -> concat(mu) -> server VAE -> split
+synthetic latents -> client decoders, loss = Σ per-client MSE + KL/batch
+(lab/hw02/Tea_Pula_HW2.ipynb cells 32-40).
+
+The cut layer is explicit: `bottoms_forward` returns the per-party
+activations (what would cross the wire up), and the server side consumes only
+the concatenation — so per-party isolation is enforceable and testable.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from .vae import kl_divergence, reparameterize
+
+NUM_CLASSES = 2
+
+
+# ------------------------------------------------------- discriminative VFL
+
+def init_bottom(key, in_dim: int, out_dim: int = 2, hidden: int = 16) -> list:
+    return nn.mlp_init(key, [in_dim, hidden, out_dim])
+
+
+def init_top(key, in_dim: int, hidden: int = 16, num_classes: int = NUM_CLASSES) -> list:
+    return nn.mlp_init(key, [in_dim, hidden, num_classes])
+
+
+def init_vfl(key, feature_dims: Sequence[int], *, bottom_out: int = 2) -> dict:
+    """One bottom model per party (sized to its feature slice) + the top."""
+    keys = jax.random.split(key, len(feature_dims) + 1)
+    bottoms = [init_bottom(keys[i], d, bottom_out) for i, d in enumerate(feature_dims)]
+    top = init_top(keys[-1], bottom_out * len(feature_dims))
+    return {"bottoms": bottoms, "top": top}
+
+
+def bottoms_forward(params: dict, xs: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Per-party forward — the activations that cross the cut layer."""
+    return [nn.mlp(b, x, final_activation=nn.relu) for b, x in zip(params["bottoms"], xs)]
+
+
+def vfl_forward(params: dict, xs: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Full split-NN forward: concat bottom outputs at the server, classify
+    (reference: vfl.py:87-89)."""
+    cut = jnp.concatenate(bottoms_forward(params, xs), axis=1)
+    return nn.mlp(params["top"], cut)
+
+
+# ------------------------------------------------------- VFL-VAE hybrid
+
+def init_vfl_vae(key, feature_dims: Sequence[int], *, client_latent: int = 4,
+                 server_latent: int = 8, enc_hidden: int = 16) -> dict:
+    """hw2 ex3 stack: per-client encoder/decoder + server VAE over the
+    concatenated client mus."""
+    n = len(feature_dims)
+    keys = jax.random.split(key, 2 * n + 2)
+    encoders = [nn.mlp_init(keys[i], [feature_dims[i], enc_hidden, client_latent]) for i in range(n)]
+    decoders = [nn.mlp_init(keys[n + i], [client_latent, enc_hidden, feature_dims[i]]) for i in range(n)]
+    concat = client_latent * n
+    k_mu, k_logvar = jax.random.split(keys[2 * n])
+    server = {
+        "mu": nn.dense_init(k_mu, concat, server_latent),
+        "logvar": nn.dense_init(k_logvar, concat, server_latent),
+        "dec": nn.mlp_init(keys[2 * n + 1], [server_latent, concat]),
+    }
+    return {"encoders": encoders, "decoders": decoders, "server": server,
+            "client_latent": client_latent}
+
+
+def vfl_vae_forward(params: dict, xs: Sequence[jnp.ndarray], key) -> Tuple[List[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Returns (per-client reconstructions, mu, logvar)."""
+    client_lat = [nn.mlp(e, x, final_activation=nn.relu) for e, x in zip(params["encoders"], xs)]
+    concat = jnp.concatenate(client_lat, axis=1)                      # the upward wire
+    mu = nn.dense(params["server"]["mu"], concat)
+    logvar = nn.dense(params["server"]["logvar"], concat)
+    z = reparameterize(key, mu, logvar)
+    synth = nn.mlp(params["server"]["dec"], z)                        # the downward wire
+    lat = params["client_latent"]
+    parts = [synth[:, i * lat:(i + 1) * lat] for i in range(len(xs))]  # split back per client
+    recons = [nn.mlp(d, p) for d, p in zip(params["decoders"], parts)]
+    return recons, mu, logvar
+
+
+def vfl_vae_loss(recons: Sequence[jnp.ndarray], xs: Sequence[jnp.ndarray],
+                 mu: jnp.ndarray, logvar: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Σ per-client mean-MSE + KL/batch (reference: Tea_Pula_HW2.ipynb cell 38
+    compute_loss). Returns (total, recon_term, kl_term)."""
+    recon = sum(jnp.mean(jnp.square(r - x)) for r, x in zip(recons, xs))
+    kl = kl_divergence(mu, logvar) / mu.shape[0]
+    return recon + kl, recon, kl

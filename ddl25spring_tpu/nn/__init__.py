@@ -1,0 +1,150 @@
+"""Minimal functional NN primitives.
+
+The reference leans on ``torch.nn`` for its layer zoo; this module is the
+framework's own equivalent: pure init/apply function pairs over plain pytrees.
+Everything composes with jit/vmap/shard_map with no module magic, which is
+what the FL client axis (vmap over clients) and the parallelism strategies
+(shard_map over mesh axes) need.
+
+Conventions:
+- ``*_init(key, ...) -> params`` returns a dict pytree of arrays.
+- apply functions are pure; layers with running state (BatchNorm) take and
+  return an explicit ``state`` pytree; stochastic layers (Dropout) take a key.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ---------------------------------------------------------------- dense
+
+def dense_init(key, in_dim: int, out_dim: int, *, scale: Optional[float] = None,
+               bias: bool = True, dtype=jnp.float32) -> dict:
+    """Kaiming-uniform by default (the torch.nn.Linear convention the
+    reference models implicitly rely on for their accuracy baselines)."""
+    kw, kb = jax.random.split(key)
+    bound = scale if scale is not None else (1.0 / jnp.sqrt(in_dim))
+    p = {"w": jax.random.uniform(kw, (in_dim, out_dim), dtype, -bound, bound)}
+    if bias:
+        p["b"] = jax.random.uniform(kb, (out_dim,), dtype, -bound, bound)
+    return p
+
+
+def dense(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    y = x @ params["w"]
+    if "b" in params:
+        y = y + params["b"]
+    return y
+
+
+# ---------------------------------------------------------------- conv2d
+
+def conv2d_init(key, in_ch: int, out_ch: int, kernel: int, *, dtype=jnp.float32) -> dict:
+    kw, kb = jax.random.split(key)
+    fan_in = in_ch * kernel * kernel
+    bound = 1.0 / jnp.sqrt(fan_in)
+    return {
+        "w": jax.random.uniform(kw, (out_ch, in_ch, kernel, kernel), dtype, -bound, bound),
+        "b": jax.random.uniform(kb, (out_ch,), dtype, -bound, bound),
+    }
+
+
+def conv2d(params: dict, x: jnp.ndarray, *, stride: int = 1, padding: str = "VALID") -> jnp.ndarray:
+    """x: [N, C, H, W] (NCHW, matching the reference's tensor layout)."""
+    y = lax.conv_general_dilated(
+        x, params["w"],
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return y + params["b"][None, :, None, None]
+
+
+def max_pool2d(x: jnp.ndarray, window: int = 2, stride: Optional[int] = None) -> jnp.ndarray:
+    stride = stride or window
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, window, window),
+        window_strides=(1, 1, stride, stride),
+        padding="VALID",
+    )
+
+
+# ---------------------------------------------------------------- norm layers
+
+def batchnorm_init(dim: int, dtype=jnp.float32) -> Tuple[dict, dict]:
+    """Returns (params, state). State carries running mean/var like
+    torch.nn.BatchNorm1d (used throughout the reference VAE,
+    lab/tutorial_2a/generative-modeling.py:17-38)."""
+    params = {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+    state = {"mean": jnp.zeros((dim,), dtype), "var": jnp.ones((dim,), dtype)}
+    return params, state
+
+
+def batchnorm(params: dict, state: dict, x: jnp.ndarray, *, train: bool,
+              momentum: float = 0.1, eps: float = 1e-5) -> Tuple[jnp.ndarray, dict]:
+    """BatchNorm over the leading (batch) axis for 2-D inputs [N, D]."""
+    if train:
+        mean = x.mean(axis=0)
+        var = x.var(axis=0)
+        n = x.shape[0]
+        unbiased = var * (n / max(n - 1, 1))
+        new_state = {
+            "mean": (1 - momentum) * state["mean"] + momentum * mean,
+            "var": (1 - momentum) * state["var"] + momentum * unbiased,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    y = (x - mean) / jnp.sqrt(var + eps)
+    return y * params["scale"] + params["bias"], new_state
+
+
+def rmsnorm_init(dim: int, dtype=jnp.float32) -> dict:
+    return {"scale": jnp.ones((dim,), dtype)}
+
+
+def rmsnorm(params: dict, x: jnp.ndarray, *, eps: float = 1e-5) -> jnp.ndarray:
+    # Compute the reduction in fp32 for stability under bf16 activations.
+    x32 = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return (x32 / rms).astype(x.dtype) * params["scale"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------- dropout
+
+def dropout(key, x: jnp.ndarray, rate: float, *, train: bool) -> jnp.ndarray:
+    if not train or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+# ---------------------------------------------------------------- activations
+
+relu = jax.nn.relu
+silu = jax.nn.silu
+gelu = jax.nn.gelu
+softmax = jax.nn.softmax
+log_softmax = jax.nn.log_softmax
+
+
+def mlp_init(key, dims: Sequence[int], *, dtype=jnp.float32) -> list:
+    """Stack of dense layers: dims = [in, h1, ..., out]."""
+    keys = jax.random.split(key, len(dims) - 1)
+    return [dense_init(k, dims[i], dims[i + 1], dtype=dtype) for i, k in enumerate(keys)]
+
+
+def mlp(params: list, x: jnp.ndarray, *, activation=relu, final_activation=None) -> jnp.ndarray:
+    for i, layer in enumerate(params):
+        x = dense(layer, x)
+        if i < len(params) - 1:
+            x = activation(x)
+        elif final_activation is not None:
+            x = final_activation(x)
+    return x

@@ -1,0 +1,1 @@
+from .losses import causal_lm_loss, cross_entropy_loss  # noqa: F401

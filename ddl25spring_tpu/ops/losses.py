@@ -1,0 +1,37 @@
+"""Loss functions.
+
+`causal_lm_loss` is the framework's equivalent of simplellm's
+``causalLLMLoss(logits, target_tokens, vocab_size)`` (reference:
+lab/tutorial_1b/primer/intro.py:29): the shift is done *inside* the loss —
+callers pass the same token batch they fed the model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                       mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean softmax cross-entropy. logits [..., C], integer labels [...]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        mask = mask.astype(nll.dtype)
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return nll.mean()
+
+
+def causal_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray, *,
+                   ignore_index: Optional[int] = None) -> jnp.ndarray:
+    """Next-token cross-entropy: logits [B, T, V] vs tokens [B, T], predicting
+    tokens[:, 1:] from logits[:, :-1]."""
+    shift_logits = logits[:, :-1]
+    shift_labels = tokens[:, 1:]
+    mask = None
+    if ignore_index is not None:
+        mask = (shift_labels != ignore_index)
+    return cross_entropy_loss(shift_logits, shift_labels, mask)

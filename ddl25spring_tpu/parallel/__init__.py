@@ -1,0 +1,1 @@
+from .mesh import make_mesh, axis_size  # noqa: F401

@@ -1,0 +1,1 @@
+from .spm import SentencePieceTokenizer, ByteTokenizer, load_tokenizer  # noqa: F401

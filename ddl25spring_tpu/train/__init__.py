@@ -1,0 +1,1 @@
+from .llm import train_llm_dp, LLMTrainReport  # noqa: F401

@@ -1,0 +1,128 @@
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data import mnist, tabular, tokens
+from ddl25spring_tpu.tokenizers import ByteTokenizer, load_tokenizer
+
+
+# ------------------------------------------------------------ tokenizer
+
+def test_tokenizer_roundtrip():
+    tok = load_tokenizer()
+    for text in ["Once upon a time", "Hello, world!", "unicode ☃ works"]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_byte_tokenizer():
+    tok = ByteTokenizer()
+    ids = tok.encode("abc", add_bos=True)
+    assert ids[0] == tok.bos_id and tok.decode(ids) == "abc"
+
+
+# ------------------------------------------------------------ token stream
+
+def test_token_stream_shapes_and_determinism():
+    tok = ByteTokenizer()
+    s1 = iter(tokens.TokenStream(tok, batch_size=3, seq_len=32, seed=0))
+    s2 = iter(tokens.TokenStream(tok, batch_size=3, seq_len=32, seed=0))
+    b1, b2 = next(s1), next(s2)
+    assert b1.shape == (3, 32) and b1.dtype == np.int32
+    assert np.array_equal(b1, b2)
+
+
+def test_token_stream_skip_offsets_data():
+    # skip=k must shift the stream by exactly k sequences (the reference's
+    # per-rank data sharding semantics, intro_DP_GA.py:29).
+    tok = ByteTokenizer()
+    base = iter(tokens.TokenStream(tok, batch_size=1, seq_len=16, seed=0))
+    skipped = iter(tokens.TokenStream(tok, batch_size=1, seq_len=16, skip=2, seed=0))
+    b0, b1, b2 = next(base), next(base), next(base)
+    assert np.array_equal(next(skipped), b2)
+    assert not np.array_equal(b0, b2)
+
+
+def test_sharded_batches():
+    tok = ByteTokenizer()
+    g = tokens.sharded_batches(tok, per_shard_batch=2, seq_len=16, n_shards=4,
+                               shard_skip=3, seed=0)
+    batch = next(g)
+    assert batch.shape == (4, 2, 16)
+    # shards must differ (disjoint stream windows)
+    assert not np.array_equal(batch[0], batch[1])
+
+
+# ------------------------------------------------------------ MNIST
+
+def test_synthetic_mnist_learnable_shapes():
+    x, y, xt, yt = mnist.load_mnist(n_train=256, n_test=64, seed=0)
+    assert x.shape == (256, 28, 28) and x.dtype == np.uint8
+    assert set(np.unique(y)) <= set(range(10))
+    norm = mnist.normalize(x)
+    assert norm.shape == (256, 1, 28, 28)
+    assert abs(float(norm.mean())) < 3.0
+
+
+def test_split_iid():
+    y = np.arange(1000) % 10
+    parts = mnist.split(y, nr_clients=10, iid=True, seed=0)
+    assert len(parts) == 10
+    all_idx = np.concatenate(parts)
+    assert len(all_idx) == 1000 and len(np.unique(all_idx)) == 1000
+    # IID: each client should see ~all classes
+    for p in parts:
+        assert len(np.unique(y[p])) == 10
+
+
+def test_split_non_iid_label_skew():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, 2000)
+    parts = mnist.split(y, nr_clients=10, iid=False, seed=0)
+    all_idx = np.concatenate(parts)
+    assert len(np.unique(all_idx)) == 2000
+    # 2-shards-per-client gives ≤ ~4 distinct labels per client (2 contiguous
+    # label ranges), vs 10 under IID — the reference's pathological skew.
+    label_counts = [len(np.unique(y[p])) for p in parts]
+    assert max(label_counts) <= 5
+    # determinism
+    parts2 = mnist.split(y, nr_clients=10, iid=False, seed=0)
+    assert all(np.array_equal(a, b) for a, b in zip(parts, parts2))
+
+
+# ------------------------------------------------------------ tabular
+
+def test_heart_load_and_preprocess():
+    X, y = tabular.load_heart()
+    assert X.shape[1] == 13 and set(np.unique(y)) <= {0, 1}
+    feats, names = tabular.preprocess(X)
+    assert feats.min() >= 0.0 and feats.max() <= 1.0
+    assert len(names) == feats.shape[1] > 13  # one-hot expansion widened it
+    # every original column represented
+    bases = {n.rsplit("_", 1)[0] if "_" in n else n for n in names}
+    assert set(tabular.COLUMNS) <= bases
+
+
+def test_feature_partitioners():
+    X, _ = tabular.load_heart()
+    _, names = tabular.preprocess(X)
+    parts = tabular.split_features_evenly(names, 4)
+    assert len(parts) == 4 and all(len(p) > 0 for p in parts)
+    # even split covers all columns exactly once
+    flat = sorted(i for p in parts for i in p)
+    assert flat == list(range(len(names)))
+    # min-2: with 10 clients and 13 base features some must duplicate
+    parts10 = tabular.split_features_with_minimum(names, 10, min_features=2, seed=0)
+    groups = tabular.base_feature_groups(names)
+    for p in parts10:
+        held = sum(1 for g in groups if set(g) <= set(p))
+        assert held >= 2
+    # permutation seed changes the even split deal order
+    a = tabular.split_features_evenly(names, 4, seed=1)
+    b = tabular.split_features_evenly(names, 4, seed=2)
+    assert a != b
+
+
+def test_train_test_split():
+    X, y = tabular.load_heart()
+    xtr, ytr, xte, yte = tabular.train_test_split(X, y, test_fraction=0.2, seed=0)
+    assert len(xte) == int(len(y) * 0.2)
+    assert len(xtr) + len(xte) == len(y)
