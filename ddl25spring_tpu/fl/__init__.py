@@ -1,0 +1,8 @@
+from .federated_data import FederatedDataset, federate  # noqa: F401
+from .servers import (  # noqa: F401
+    CentralizedServer,
+    FedAvgGradServer,
+    FedAvgServer,
+    FedSgdGradientServer,
+    FedSgdWeightServer,
+)

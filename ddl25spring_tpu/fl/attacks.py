@@ -1,0 +1,151 @@
+"""Byzantine client attacks.
+
+Capability targets (lab/tutorial_3/attacks_and_defenses.ipynb):
+- gradient reversion: return −5·Δ (cells 9, 35)
+- partial gradient reversion: flip only the first ~1e-5 of parameters by
+  ×(−1000), evading distance-based defenses (cell 41)
+- untargeted label flipping: train on (y+1) mod 10, return 5·Δ (cell 11)
+- targeted label flipping: flip only source→target labels, return 5·Δ (cell 14)
+- pixel-pattern backdoor: stamp a 5×3 pattern at (3, 23) with an extreme
+  pixel value, poison a proportion of each batch toward the backdoor label,
+  return scaled Δ (cells 23-31, 50)
+
+Design: attacks are stateless objects with a uniform, jit-compatible
+protocol; the server applies them only where the Byzantine mask is set, so a
+single vmapped program trains honest and malicious clients together:
+
+- ``poisons_data`` — whether local training data is transformed
+- ``poison(x, y, key) -> (x, y)`` — data-poisoning hook (whole padded subset)
+- ``transform(delta, params) -> delta`` — model-poisoning hook on Δ
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import pytree as pt
+
+PyTree = Any
+
+
+class Attack:
+    poisons_data: bool = False
+
+    def poison(self, x, y, key):
+        return x, y
+
+    def transform(self, delta: PyTree, params: PyTree) -> PyTree:
+        return delta
+
+
+@dataclass
+class GradientReversion(Attack):
+    """Return −scale·Δ (reference: cell 35, scale 5)."""
+    scale: float = 5.0
+    poisons_data = False
+
+    def transform(self, delta, params):
+        return pt.tree_scale(delta, -self.scale)
+
+
+@dataclass
+class PartialGradientReversion(Attack):
+    """Flip a tiny leading slice of the flattened update by ×(−factor):
+    large damage, small L2 displacement — evades Krum-style distance
+    filtering (reference: cell 41, first layers ≈1e-5 of params, ×−1000)."""
+    factor: float = 1000.0
+    fraction: float = 1e-5
+    poisons_data = False
+
+    def transform(self, delta, params):
+        flat, unflatten = pt.flatten(delta)
+        k = max(1, int(flat.shape[0] * self.fraction))
+        flipped = flat.at[:k].multiply(-self.factor)
+        return unflatten(flipped)
+
+
+@dataclass
+class UntargetedLabelFlip(Attack):
+    """Local training labels become (y+1) mod num_classes; update scaled
+    (reference: cell 11, 5·Δ)."""
+    num_classes: int = 10
+    scale: float = 5.0
+    poisons_data = True
+
+    def poison(self, x, y, key):
+        return x, (y + 1) % self.num_classes
+
+    def transform(self, delta, params):
+        return pt.tree_scale(delta, self.scale)
+
+
+@dataclass
+class TargetedLabelFlip(Attack):
+    """Only source-class labels flip to the target class (reference: cell 14,
+    0→6, 5·Δ)."""
+    source: int = 0
+    target: int = 6
+    scale: float = 5.0
+    poisons_data = True
+
+    def poison(self, x, y, key):
+        return x, jnp.where(y == self.source, self.target, y)
+
+    def transform(self, delta, params):
+        return pt.tree_scale(delta, self.scale)
+
+
+@dataclass
+class PatternBackdoor(Attack):
+    """Pixel-pattern backdoor (reference: cells 23-31): stamp a pattern of
+    extreme pixel values into a proportion of each client's samples and
+    relabel them to the backdoor label; scale the resulting update.
+
+    ``pattern_value`` is in *normalized* space — the reference uses −10, far
+    outside MNIST's normalized range, making the trigger unmistakable.
+    """
+    proportion: float = 0.3
+    backdoor_label: int = 0
+    scale: float = 2.0
+    row: int = 3
+    col: int = 23
+    height: int = 5
+    width: int = 3
+    pattern_value: float = -10.0
+    poisons_data = True
+
+    def _stamp(self, x) -> jnp.ndarray:
+        """x: [S, 1, 28, 28] (NCHW, normalized); accepts numpy or jax arrays."""
+        return jnp.asarray(x).at[..., self.row:self.row + self.height,
+                                 self.col:self.col + self.width].set(self.pattern_value)
+
+    def poison(self, x, y, key):
+        poisoned = jax.random.bernoulli(key, self.proportion, y.shape)
+        x = jnp.where(poisoned[:, None, None, None], self._stamp(x), x)
+        y = jnp.where(poisoned, self.backdoor_label, y)
+        return x, y
+
+    def transform(self, delta, params):
+        return pt.tree_scale(delta, self.scale)
+
+    def trigger_test_set(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Fully-triggered copy of a test set, for attack-success-rate
+        evaluation (reference: cell 30)."""
+        return self._stamp(x)
+
+
+def injection_mask(nr_clients: int, fraction: float, seed: int) -> jnp.ndarray:
+    """Byzantine fault injection: mark a random ``fraction`` of clients
+    malicious (reference: cell 9 — num_malicious = int(0.20·len(clients)),
+    np.random.choice over indices)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_mal = int(fraction * nr_clients)
+    mask = np.zeros(nr_clients, dtype=bool)
+    mask[rng.choice(nr_clients, n_mal, replace=False)] = True
+    return jnp.asarray(mask)

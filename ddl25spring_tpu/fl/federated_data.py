@@ -1,0 +1,48 @@
+"""Client-axis data layout for federated learning.
+
+The reference hands each simulated client a torch Subset of MNIST
+(lab/tutorial_1a/hfl_complete.py:141-150, split() at :91-104). The TPU-native
+layout instead *stacks* every client's subset along a leading ``client`` axis
+— ``x: [N, S, ...]``, ``y: [N, S]``, ``mask: [N, S]`` — so local training
+vmaps over clients and aggregation rules are reductions over axis 0. Unequal
+subset sizes are padded to the max and masked; ``sample_counts`` carries the
+true sizes for FedAvg's weighting (hfl_complete.py:366-368).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class FederatedDataset:
+    x: jnp.ndarray            # [N, S, ...] padded client inputs
+    y: jnp.ndarray            # [N, S] padded labels
+    mask: jnp.ndarray         # [N, S] 1.0 for real samples, 0.0 for padding
+    sample_counts: jnp.ndarray  # [N] true subset sizes
+
+    @property
+    def nr_clients(self) -> int:
+        return self.x.shape[0]
+
+
+def federate(x: np.ndarray, y: np.ndarray, subsets: Sequence[np.ndarray]) -> FederatedDataset:
+    """Stack per-client index subsets into the padded client-axis layout."""
+    n = len(subsets)
+    s_max = max(len(s) for s in subsets)
+    xs = np.zeros((n, s_max) + x.shape[1:], dtype=x.dtype)
+    ys = np.zeros((n, s_max), dtype=y.dtype)
+    mask = np.zeros((n, s_max), dtype=np.float32)
+    counts = np.zeros((n,), dtype=np.int32)
+    for i, idx in enumerate(subsets):
+        k = len(idx)
+        xs[i, :k] = x[idx]
+        ys[i, :k] = y[idx]
+        mask[i, :k] = 1.0
+        counts[i] = k
+    return FederatedDataset(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask),
+                            jnp.asarray(counts))

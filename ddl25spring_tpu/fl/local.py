@@ -1,0 +1,79 @@
+"""Client-local training kernels — pure, vmappable over the client axis.
+
+Capability target: the reference's client `update()` bodies —
+`train_epoch` SGD over the client's DataLoader (lab/tutorial_1a/
+hfl_complete.py:71-80, WeightClient.update :318-326) and the full-subset
+gradient of `GradientClient` (:226-253). The reference's client loaders use
+``shuffle=False`` (:148-149), so batch order is the subset order — preserved
+here by reshaping the padded subset into fixed batches, which keeps every
+shape static under jit/vmap.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+# apply_fn(params, x) -> logits
+ApplyFn = Callable[[PyTree, jnp.ndarray], jnp.ndarray]
+
+
+def masked_mean_loss(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray,
+                     y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Cross-entropy averaged over real (unmasked) samples — identical to
+    torch's mean CE over a batch when mask is all-ones."""
+    logits = apply_fn(params, x)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def full_batch_grad(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray,
+                    y: jnp.ndarray, mask: jnp.ndarray) -> Tuple[jnp.ndarray, PyTree]:
+    """One gradient over the client's whole subset — FedSGD's client step
+    (GradientClient.update, hfl_complete.py:241-253). Returns (loss, grads)."""
+    return jax.value_and_grad(partial(masked_mean_loss, apply_fn))(params, x, y, mask)
+
+
+def _batched(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, batch_size: int):
+    """Reshape a padded subset [S, ...] into [n_batches, B, ...] (pad tail)."""
+    s = x.shape[0]
+    if batch_size <= 0 or batch_size > s:   # B=-1 ⇒ ∞ (one full batch)
+        batch_size = s
+    n_batches = -(-s // batch_size)
+    pad = n_batches * batch_size - s
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+        y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+        mask = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
+    return (x.reshape((n_batches, batch_size) + x.shape[1:]),
+            y.reshape(n_batches, batch_size),
+            mask.reshape(n_batches, batch_size))
+
+
+def local_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray, y: jnp.ndarray,
+              mask: jnp.ndarray, *, epochs: int, batch_size: int, lr: float) -> PyTree:
+    """E epochs of plain SGD over fixed-order minibatches — WeightClient's
+    local loop (train_epoch, hfl_complete.py:71-80). Pure: returns the new
+    params; scan over (epochs × batches) keeps one compiled body."""
+    xb, yb, mb = _batched(x, y, mask, batch_size)
+
+    def batch_step(p, batch):
+        bx, by, bm = batch
+        grads = jax.grad(partial(masked_mean_loss, apply_fn))(p, bx, by, bm)
+        # Empty (all-padding) batches contribute zero gradient.
+        nonempty = (bm.sum() > 0).astype(jnp.float32)
+        p = jax.tree.map(lambda w, g: w - lr * nonempty * g, p, grads)
+        return p, None
+
+    def epoch_step(p, _):
+        p, _ = lax.scan(batch_step, p, (xb, yb, mb))
+        return p, None
+
+    params, _ = lax.scan(epoch_step, params, None, length=epochs)
+    return params

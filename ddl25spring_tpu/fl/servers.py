@@ -1,0 +1,264 @@
+"""Horizontal-FL servers: FedSGD (gradient & weight), FedAvg, and baselines.
+
+Capability targets (lab/tutorial_1a/hfl_complete.py):
+- `FedSgdGradientServer` :256-308 — sampled clients return one full-subset
+  gradient; server applies the sample-count-weighted average with lr.
+- FedSGD weight variant (hw1 A1) — clients take the SGD step locally and
+  upload weights; must match the gradient variant to ~0.02% test accuracy
+  (lab/hw01/homework-1.ipynb cell 9).
+- `FedAvgServer` :332-386 — E local epochs, C·N sampled clients, B batch,
+  sample-count weighting, per-round RunResult metrics.
+- `FedAvgGradServer` (lab/tutorial_3/attacks_and_defenses.ipynb cell 4) — the
+  delta-upload reframing (client returns Δ = w_init − w_final; server does
+  w ← w − avg(Δ)) that all attacks and Byzantine defenses plug into.
+- `CentralizedServer` :184-223 — the non-federated baseline.
+
+TPU-native design: clients are not processes or objects — a round is ONE
+jitted program that gathers the sampled clients' padded subsets from the
+stacked client axis, vmaps the local-training kernel over them, and reduces
+with a weighted sum. Client sampling and the per-(client, round) seed formula
+stay on the host, observable and bit-reproducible (rng.py).
+
+The aggregation point is an explicit hook (``defense=``): selection defenses
+(Krum family) return surviving client indices; aggregation defenses
+(median family) replace the weighted mean entirely — mirroring the
+FedAvgServerDefense / FedAvgServerDefenseCoordinate split (cells 34, 43).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import rng as rngmod
+from ..config import FLConfig
+from ..metrics import RunResult, message_count
+from ..utils import pytree as pt
+from .federated_data import FederatedDataset
+from .local import full_batch_grad, local_sgd, masked_mean_loss
+
+PyTree = Any
+
+
+def _weights_for(counts: jnp.ndarray) -> jnp.ndarray:
+    """Sample-count FedAvg weights over the sampled clients
+    (hfl_complete.py:366-368)."""
+    c = counts.astype(jnp.float32)
+    return c / jnp.maximum(c.sum(), 1.0)
+
+
+class _ServerBase:
+    """Shared plumbing: jitted test(), client sampling, metrics."""
+
+    def __init__(self, init_params: PyTree, apply_fn, data: FederatedDataset,
+                 test_x: jnp.ndarray, test_y: jnp.ndarray, cfg: FLConfig,
+                 algorithm: str):
+        self.apply_fn = apply_fn
+        self.params = init_params
+        self.data = data
+        self.test_x = jnp.asarray(test_x)
+        self.test_y = jnp.asarray(test_y)
+        self.cfg = cfg
+        self.result = RunResult(algorithm, cfg.nr_clients, cfg.client_fraction,
+                                cfg.batch_size, cfg.epochs, cfg.lr, cfg.seed)
+
+        @jax.jit
+        def _test(params):
+            logits = apply_fn(params, self.test_x)
+            return (logits.argmax(-1) == self.test_y).mean()
+
+        self._test = _test
+
+    def test(self) -> float:
+        """Full-test-set accuracy in one batch (hfl_complete.py:170-181)."""
+        return float(self._test(self.params))
+
+    def _sample(self, round_idx: int) -> np.ndarray:
+        return np.asarray(rngmod.sample_clients(
+            self.cfg.seed, round_idx, self.cfg.nr_clients, self.cfg.clients_per_round))
+
+    def client_seeds(self, round_idx: int, client_idx: np.ndarray) -> np.ndarray:
+        """The reference's observable per-(client, round) seed vector:
+        seed + ind + 1 + round·m with ind the sampled client's GLOBAL index
+        (hfl_complete.py:364) — so a client's local randomness is identical
+        regardless of its position in the sampling order."""
+        m = self.cfg.clients_per_round
+        return np.asarray([rngmod.per_client_seed(self.cfg.seed, round_idx, int(i), m)
+                           for i in client_idx])
+
+    def _record(self, round_idx: int, wall: float) -> None:
+        self.result.record_round(
+            wall, message_count(round_idx, self.cfg.clients_per_round), self.test())
+
+    def run(self, nr_rounds: Optional[int] = None) -> RunResult:
+        nr_rounds = self.cfg.rounds if nr_rounds is None else nr_rounds
+        for r in range(nr_rounds):
+            t0 = time.perf_counter()
+            self.params = self._round(self.params, r)
+            jax.block_until_ready(self.params)
+            self._record(r, time.perf_counter() - t0)
+        return self.result
+
+
+class FedSgdGradientServer(_ServerBase):
+    """One full-subset gradient per sampled client, weighted-averaged, one
+    server SGD step per round (hfl_complete.py:256-308)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, algorithm="fedsgd", **kw)
+        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
+
+        @jax.jit
+        def round_step(params, idx):
+            xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
+            _, grads = jax.vmap(lambda x, y, m: full_batch_grad(apply_fn, params, x, y, m)
+                                )(xs, ys, ms)
+            w = _weights_for(data.sample_counts[idx])
+            agg = pt.tree_weighted_sum(grads, w)
+            return jax.tree.map(lambda p, g: p - cfg.lr * g, params, agg)
+
+        self._round_step = round_step
+
+    def _round(self, params, r):
+        return self._round_step(params, jnp.asarray(self._sample(r)))
+
+
+class FedSgdWeightServer(_ServerBase):
+    """Equivalent reformulation: clients take the lr·grad step locally and
+    upload weights; the server weighted-averages them (hw1 A1)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, algorithm="fedsgd-w", **kw)
+        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
+
+        @jax.jit
+        def round_step(params, idx):
+            xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
+
+            def client(x, y, m):
+                _, g = full_batch_grad(apply_fn, params, x, y, m)
+                return jax.tree.map(lambda p, gi: p - cfg.lr * gi, params, g)
+
+            new_weights = jax.vmap(client)(xs, ys, ms)
+            w = _weights_for(data.sample_counts[idx])
+            return pt.tree_weighted_sum(new_weights, w)
+
+        self._round_step = round_step
+
+    def _round(self, params, r):
+        return self._round_step(params, jnp.asarray(self._sample(r)))
+
+
+class FedAvgServer(_ServerBase):
+    """E local SGD epochs per sampled client, weight upload, sample-count
+    weighted average (hfl_complete.py:332-386)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, algorithm="fedavg", **kw)
+        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
+
+        @jax.jit
+        def round_step(params, idx):
+            xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
+            new_weights = jax.vmap(
+                lambda x, y, m: local_sgd(apply_fn, params, x, y, m,
+                                          epochs=cfg.epochs, batch_size=cfg.batch_size,
+                                          lr=cfg.lr))(xs, ys, ms)
+            w = _weights_for(data.sample_counts[idx])
+            return pt.tree_weighted_sum(new_weights, w)
+
+        self._round_step = round_step
+
+    def _round(self, params, r):
+        return self._round_step(params, jnp.asarray(self._sample(r)))
+
+
+class FedAvgGradServer(_ServerBase):
+    """Delta-upload FedAvg: clients return Δ = w_server − w_local_final and
+    the server applies w ← w − aggregate(Δ) — the substrate every attack and
+    defense plugs into (attacks_and_defenses.ipynb cell 4).
+
+    ``adversary``: optional (mask, attack) — mask [N] bool marks Byzantine
+    clients; attack transforms their honest deltas (and/or local batches).
+    ``defense``: optional aggregation hook (see fl.defenses).
+    """
+
+    def __init__(self, *args, adversary=None, defense=None, **kw):
+        super().__init__(*args, algorithm="fedavg-grad", **kw)
+        self.adversary = adversary
+        self.defense = defense
+        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
+        attack = adversary[1] if adversary is not None else None
+        malicious_mask = jnp.asarray(adversary[0]) if adversary is not None else None
+
+        @jax.jit
+        def round_step(params, idx, keys):
+            xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
+
+            def client(x, y, m, key, is_mal):
+                if attack is not None and attack.poisons_data:
+                    # Data poisoning: malicious clients train on transformed
+                    # batches (label flips, backdoor stamps).
+                    px, py = attack.poison(x, y, key)
+                    x = jnp.where(is_mal, px, x)
+                    y = jnp.where(is_mal, py, y)
+                new = local_sgd(apply_fn, params, x, y, m, epochs=cfg.epochs,
+                                batch_size=cfg.batch_size, lr=cfg.lr)
+                delta = pt.tree_sub(params, new)           # Δ = w0 − w_final
+                if attack is not None:
+                    mal_delta = attack.transform(delta, params)
+                    delta = jax.tree.map(
+                        lambda h, a: jnp.where(is_mal, a, h), delta, mal_delta)
+                return delta
+
+            is_mal = (malicious_mask[idx] if malicious_mask is not None
+                      else jnp.zeros(idx.shape, bool))
+            deltas = jax.vmap(client)(xs, ys, ms, keys, is_mal)
+            w = _weights_for(data.sample_counts[idx])
+            if defense is None:
+                agg = pt.tree_weighted_sum(deltas, w)
+            else:
+                agg = defense(deltas, w)
+            return pt.tree_sub(params, agg)
+
+        self._round_step = round_step
+
+    def _round(self, params, r):
+        idx = self._sample(r)
+        keys = jax.vmap(jax.random.key)(jnp.asarray(self.client_seeds(r, idx)))
+        return self._round_step(params, jnp.asarray(idx), keys)
+
+
+class CentralizedServer(_ServerBase):
+    """Non-federated baseline: plain minibatch SGD over the whole training
+    set, one epoch per round (hfl_complete.py:184-223)."""
+
+    def __init__(self, init_params, apply_fn, x, y, test_x, test_y, cfg: FLConfig):
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        data = FederatedDataset(x[None], y[None], jnp.ones(y.shape, jnp.float32)[None],
+                                jnp.asarray([y.shape[0]]))
+        super().__init__(init_params, apply_fn, data, test_x, test_y, cfg,
+                         algorithm="centralized")
+        # The baseline is one node: N=1, C=1, E=1, and zero messages per
+        # round (reference: hfl_complete.py:205 appends message_count 0).
+        self.result = RunResult("centralized", 1, 1.0, cfg.batch_size, 1,
+                                cfg.lr, cfg.seed)
+
+        @jax.jit
+        def round_step(params):
+            return local_sgd(apply_fn, params, data.x[0], data.y[0], data.mask[0],
+                             epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr)
+
+        self._round_step = round_step
+
+    def _round(self, params, r):
+        return self._round_step(params)
+
+    def _record(self, round_idx: int, wall: float) -> None:
+        self.result.record_round(wall, 0, self.test())

@@ -1,0 +1,179 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.config import FLConfig
+from ddl25spring_tpu.data import mnist
+from ddl25spring_tpu.fl import FedAvgGradServer, federate
+from ddl25spring_tpu.fl import attacks, defenses
+from ddl25spring_tpu.metrics import backdoor_metrics
+from ddl25spring_tpu.models import mnist_cnn
+from ddl25spring_tpu.utils import pytree as pt
+
+
+# ------------------------------------------------------------ defense units
+
+def _flat(rows):
+    return jnp.asarray(rows, dtype=jnp.float32)
+
+
+def test_krum_rejects_outlier():
+    flat = _flat([[0.0], [0.1], [0.2], [10.0]])
+    assert int(defenses.krum(flat, n_malicious=1)) != 3
+    scores = defenses.krum_scores(flat, 1)
+    assert float(scores[3]) > float(scores[:3].max())
+
+
+def test_multi_krum_selects_honest_cluster():
+    flat = _flat([[0.0], [0.1], [0.2], [10.0], [-9.0]])
+    winners = np.asarray(defenses.multi_krum(flat, n_malicious=2, k=3))
+    assert len(set(winners.tolist())) == 3
+    assert set(winners.tolist()) <= {0, 1, 2}
+
+
+def test_coordinate_median_and_trimmed_mean_hand_case():
+    flat = _flat([[1.0, -5.0], [2.0, 0.0], [3.0, 5.0], [100.0, 1.0]])
+    med = defenses.coordinate_median(flat)
+    np.testing.assert_allclose(np.asarray(med), [2.5, 0.5])
+    tm = defenses.trimmed_mean(flat, beta=0.25)  # drop 1 high + 1 low per coord
+    np.testing.assert_allclose(np.asarray(tm), [2.5, 0.5])
+
+
+def test_majority_sign_hand_case():
+    flat = _flat([[1.0, -1.0], [2.0, -2.0], [-3.0, -3.0]])
+    out = defenses.majority_sign(flat)
+    # Disagreeing entries are zeroed but stay in the denominator (reference
+    # cell 49): coord 0 -> (1+2+0)/3, coord 1 -> (-1-2-3)/3.
+    np.testing.assert_allclose(np.asarray(out), [1.0, -2.0])
+
+
+def test_norm_clipping_bounds_outlier():
+    flat = _flat([[1.0, 0.0], [0.0, 1.0], [100.0, 0.0]])
+    out = defenses.norm_clipping(flat, ratio=1.0)
+    # all norms clipped to mean norm 34 -> outlier contributes ≤ 34
+    assert float(jnp.abs(out).max()) < 34.1
+
+
+def test_bulyan_ignores_attackers():
+    honest = [[0.0], [0.1], [0.2], [0.15], [0.05]]
+    attackers = [[50.0], [-50.0]]
+    flat = _flat(honest + attackers)
+    out = defenses.bulyan(flat, n_malicious=2, k=4, beta=0.25)
+    assert 0.0 <= float(out[0]) <= 0.2
+
+
+def test_sparse_fed_topk():
+    flat = _flat([[1.0, 0.01, -2.0, 0.02]])
+    out = defenses.sparse_fed(flat, topk_fraction=0.5)
+    np.testing.assert_allclose(np.asarray(out), [1.0, 0.0, -2.0, 0.0])
+
+
+def test_stack_flat_roundtrip():
+    deltas = {"a": jnp.arange(6.0).reshape(3, 2), "b": jnp.ones((3, 2, 2))}
+    flat, unflatten = defenses.stack_flat(deltas)
+    assert flat.shape == (3, 6)
+    one = unflatten(flat[1])
+    np.testing.assert_allclose(np.asarray(one["a"]), [2.0, 3.0])
+    assert one["b"].shape == (2, 2)
+
+
+# ------------------------------------------------------------ attack units
+
+def test_gradient_reversion_scales():
+    delta = {"w": jnp.ones(3)}
+    out = attacks.GradientReversion(scale=5.0).transform(delta, None)
+    np.testing.assert_allclose(np.asarray(out["w"]), -5.0 * np.ones(3))
+
+
+def test_partial_reversion_touches_prefix_only():
+    delta = {"w": jnp.ones(100000)}
+    out = attacks.PartialGradientReversion(factor=1000.0, fraction=1e-5).transform(delta, None)
+    flat = np.asarray(out["w"])
+    assert flat[0] == -1000.0
+    assert (flat[2:] == 1.0).all()
+
+
+def test_label_flips():
+    y = jnp.array([0, 1, 9])
+    _, y2 = attacks.UntargetedLabelFlip().poison(None, y, None)
+    np.testing.assert_array_equal(np.asarray(y2), [1, 2, 0])
+    _, y3 = attacks.TargetedLabelFlip(source=0, target=6).poison(None, y, None)
+    np.testing.assert_array_equal(np.asarray(y3), [6, 1, 9])
+
+
+def test_backdoor_stamps_pattern_and_relabels():
+    atk = attacks.PatternBackdoor(proportion=1.0, backdoor_label=0)
+    x = jnp.zeros((4, 1, 28, 28))
+    y = jnp.array([3, 4, 5, 6])
+    px, py = atk.poison(x, y, jax.random.key(0))
+    assert (np.asarray(py) == 0).all()
+    region = np.asarray(px)[:, 0, 3:8, 23:26]
+    assert (region == -10.0).all()
+    assert np.asarray(px)[:, 0, 0, 0].max() == 0.0  # untouched elsewhere
+    trig = atk.trigger_test_set(x)
+    assert (np.asarray(trig)[:, 0, 3:8, 23:26] == -10.0).all()
+
+
+def test_injection_mask_fraction():
+    mask = np.asarray(attacks.injection_mask(100, 0.2, seed=0))
+    assert mask.sum() == 20
+    mask2 = np.asarray(attacks.injection_mask(100, 0.2, seed=0))
+    np.testing.assert_array_equal(mask, mask2)
+
+
+# ------------------------------------------------------------ end-to-end
+
+@pytest.fixture(scope="module")
+def fl_attack_setup():
+    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=800, n_test=300, seed=0)
+    x = mnist.normalize(x_raw)
+    xt = mnist.normalize(xt_raw)
+    cfg = FLConfig(nr_clients=10, client_fraction=0.5, batch_size=40, epochs=2,
+                   lr=0.1, rounds=5, seed=42)
+    subsets = mnist.split(y, cfg.nr_clients, iid=True, seed=cfg.seed)
+    data = federate(x, y.astype(np.int32), subsets)
+    params = mnist_cnn.init(jax.random.key(0))
+    return params, data, xt, yt.astype(np.int32), cfg
+
+
+def test_gradient_reversion_hurts_and_median_defends(fl_attack_setup):
+    """The reference's signature experiment (hw03): 20% gradient-reversion
+    attackers wreck FedAvg; robust aggregation restores learning. The
+    coordinate-median defense is used here because at this tiny scale
+    (m=5 sampled, f=2) Krum's n−f−2=1-nearest scoring lets colluding
+    attackers cluster — an inherent Krum property, covered at mechanism
+    level in the unit tests above."""
+    params, data, xt, yt, cfg = fl_attack_setup
+    mask = attacks.injection_mask(cfg.nr_clients, 0.2, seed=1)
+    atk = attacks.GradientReversion(scale=5.0)
+
+    honest = FedAvgGradServer(params, mnist_cnn.apply, data, xt, yt, cfg)
+    attacked = FedAvgGradServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                                adversary=(mask, atk))
+    defended = FedAvgGradServer(
+        params, mnist_cnn.apply, data, xt, yt, cfg,
+        adversary=(mask, atk),
+        defense=defenses.coordinate_defense(defenses.coordinate_median))
+
+    acc_honest = honest.run(5).test_accuracy[-1]
+    acc_attacked = attacked.run(5).test_accuracy[-1]
+    acc_defended = defended.run(5).test_accuracy[-1]
+
+    assert acc_attacked < acc_honest - 0.15     # the attack bites
+    assert acc_defended > acc_attacked + 0.15   # the defense restores learning
+
+
+def test_backdoor_asr_pipeline(fl_attack_setup):
+    """Backdoor mechanics end-to-end: ASR metric computable on the fully
+    triggered test set (reference cell 30)."""
+    params, data, xt, yt, cfg = fl_attack_setup
+    mask = attacks.injection_mask(cfg.nr_clients, 0.5, seed=1)
+    atk = attacks.PatternBackdoor(proportion=0.5, backdoor_label=0, scale=2.0)
+    server = FedAvgGradServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                              adversary=(mask, atk))
+    server.run(3)
+    clean_pred = np.asarray(server.apply_fn(server.params, xt).argmax(-1))
+    trig_pred = np.asarray(server.apply_fn(server.params, atk.trigger_test_set(xt)).argmax(-1))
+    clean_acc, asr = backdoor_metrics(clean_pred, np.asarray(yt), trig_pred, 0)
+    assert 0.0 <= asr <= 1.0 and 0.0 <= clean_acc <= 1.0
