@@ -96,6 +96,9 @@ class _ServerBase:
         self.result.record_round(
             wall, message_count(round_idx, self.cfg.clients_per_round), self.test())
 
+    def _round(self, params, r):
+        return self._round_step(params, jnp.asarray(self._sample(r)))
+
     def run(self, nr_rounds: Optional[int] = None) -> RunResult:
         nr_rounds = self.cfg.rounds if nr_rounds is None else nr_rounds
         for r in range(nr_rounds):
@@ -125,9 +128,6 @@ class FedSgdGradientServer(_ServerBase):
 
         self._round_step = round_step
 
-    def _round(self, params, r):
-        return self._round_step(params, jnp.asarray(self._sample(r)))
-
 
 class FedSgdWeightServer(_ServerBase):
     """Equivalent reformulation: clients take the lr·grad step locally and
@@ -151,9 +151,6 @@ class FedSgdWeightServer(_ServerBase):
 
         self._round_step = round_step
 
-    def _round(self, params, r):
-        return self._round_step(params, jnp.asarray(self._sample(r)))
-
 
 class FedAvgServer(_ServerBase):
     """E local SGD epochs per sampled client, weight upload, sample-count
@@ -174,9 +171,6 @@ class FedAvgServer(_ServerBase):
             return pt.tree_weighted_sum(new_weights, w)
 
         self._round_step = round_step
-
-    def _round(self, params, r):
-        return self._round_step(params, jnp.asarray(self._sample(r)))
 
 
 class FedAvgGradServer(_ServerBase):
@@ -251,14 +245,21 @@ class CentralizedServer(_ServerBase):
                                 cfg.lr, cfg.seed)
 
         @jax.jit
-        def round_step(params):
-            return local_sgd(apply_fn, params, data.x[0], data.y[0], data.mask[0],
-                             epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr)
+        def round_step(params, r):
+            # The reference's centralized DataLoader reshuffles every round
+            # (hfl_complete.py:194-195, shuffle=True) and runs exactly ONE
+            # epoch per round (:202-205) — cfg.epochs is a federated knob
+            # and does not apply to the baseline.
+            perm = jax.random.permutation(
+                jax.random.fold_in(jax.random.key(cfg.seed), r), data.y.shape[1])
+            return local_sgd(apply_fn, params, data.x[0][perm], data.y[0][perm],
+                             data.mask[0][perm], epochs=1,
+                             batch_size=cfg.batch_size, lr=cfg.lr)
 
         self._round_step = round_step
 
     def _round(self, params, r):
-        return self._round_step(params)
+        return self._round_step(params, r)
 
     def _record(self, round_idx: int, wall: float) -> None:
         self.result.record_round(wall, 0, self.test())
