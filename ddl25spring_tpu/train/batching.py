@@ -1,0 +1,35 @@
+"""Shared host-side minibatching: pad to whole batches + validity mask.
+
+The reference's DataLoaders keep the partial last batch (e.g. lab/tutorial_2b/
+vfl.py:66-71); under jit we scan over a fixed [n_batches, batch_size, ...]
+layout instead, so the remainder is zero-padded and masked rather than
+dropped — losses/accuracies weight by the mask and match exactly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_batches(arrays: Sequence[np.ndarray], y: np.ndarray, batch_size: int
+                ) -> Tuple[tuple, jnp.ndarray, jnp.ndarray]:
+    """Reshape each array (and labels) to [n_batches, batch_size, ...].
+
+    Returns (xs, y_batched, mask) where ``xs`` is a tuple (one entry per
+    input array — VFL passes one per party) and ``mask`` flags real rows.
+    """
+    n = y.shape[0]
+    n_batches = math.ceil(n / batch_size)
+    pad = n_batches * batch_size - n
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+
+    def pad_reshape(a):
+        a = np.concatenate([a, np.zeros((pad, *a.shape[1:]), a.dtype)])
+        return jnp.asarray(a.reshape(n_batches, batch_size, *a.shape[1:]))
+
+    xs = tuple(pad_reshape(a) for a in arrays)
+    return xs, pad_reshape(y), jnp.asarray(mask.reshape(n_batches, batch_size))

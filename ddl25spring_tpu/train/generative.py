@@ -1,0 +1,125 @@
+"""Generative modeling: VAE training + the synthetic-data evaluation protocol.
+
+Capability targets (lab/tutorial_2a/generative-modeling.py):
+- `train_vae` — minibatch Adam on the BatchNorm-MLP VAE with the MSE(sum)+KLD
+  `customLoss` (:119-128, training loop :131-163).
+- `synthetic_data_eval` — the evaluation protocol (:165-209): draw synthetic
+  rows from the trained VAE (decode z ~ N(0, I)), train one evaluator
+  classifier on the REAL training set and another on the SYNTHETIC set, and
+  compare their accuracies on the same held-out real test set. Synthetic data
+  is "good" when the synthetic-trained evaluator approaches the real-trained
+  one.
+
+Labels for synthetic rows: the reference trains the VAE per-class (one VAE on
+each label's rows) so sampled rows inherit the class of their generator —
+`synthetic_data_eval` follows that per-class scheme.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..config import VAEConfig
+from ..models import vae
+from .tabular import ClassifierReport, train_classifier
+
+
+@dataclass
+class VAEReport:
+    total_losses: List[float] = field(default_factory=list)   # per epoch means
+    mse_losses: List[float] = field(default_factory=list)
+    kld_losses: List[float] = field(default_factory=list)
+
+
+def train_vae(x_train: np.ndarray, cfg: Optional[VAEConfig] = None, *,
+              log_every: int = 0, log_fn: Callable[[str], None] = print
+              ) -> Tuple[dict, dict, VAEReport]:
+    """Train the VAE; returns (params, batchnorm_state, report)."""
+    cfg = cfg or VAEConfig(input_dim=int(x_train.shape[1]))
+    params, state = vae.init(jax.random.key(cfg.seed), cfg)
+    optimizer = optax.adam(cfg.lr)
+    opt_state = optimizer.init(params)
+
+    n = x_train.shape[0]
+    # BatchNorm needs full batches, so the tail remainder is dropped; a
+    # training set smaller than batch_size becomes one full-dataset batch.
+    bs = min(cfg.batch_size, n)
+    n_batches = n // bs
+    x_use = x_train[:n_batches * bs]
+    xb = jnp.asarray(x_use.reshape(n_batches, bs, -1), jnp.float32)
+
+    def minibatch_step(carry, batch):
+        params, state, opt_state, key = carry
+        x = batch
+        key, sub = jax.random.split(key)
+
+        def loss_fn(p):
+            recon, mu, logvar, new_state = vae.apply(p, state, x, sub, train=True)
+            total, mse, kld = vae.loss_fn(recon, x, mu, logvar)
+            return total, (mse, kld, new_state)
+
+        (total, (mse, kld, new_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, new_state, opt_state, key), (total, mse, kld)
+
+    @jax.jit
+    def epoch_fn(params, state, opt_state, key):
+        (params, state, opt_state, _), (tot, mse, kld) = jax.lax.scan(
+            minibatch_step, (params, state, opt_state, key), xb)
+        return params, state, opt_state, tot.mean(), mse.mean(), kld.mean()
+
+    report = VAEReport()
+    key = jax.random.key(cfg.seed + 1)
+    for epoch in range(cfg.epochs):
+        key, sub = jax.random.split(key)
+        params, state, opt_state, tot, mse, kld = epoch_fn(params, state, opt_state, sub)
+        report.total_losses.append(float(tot))
+        report.mse_losses.append(float(mse))
+        report.kld_losses.append(float(kld))
+        if log_every and epoch % log_every == 0:
+            log_fn(f"epoch {epoch}: loss {report.total_losses[-1]:.2f} "
+                   f"(mse {report.mse_losses[-1]:.2f} kld {report.kld_losses[-1]:.2f})")
+    return params, state, report
+
+
+@dataclass
+class SyntheticEvalResult:
+    real_accuracy: float
+    synthetic_accuracy: float
+    vae_reports: List[VAEReport] = field(default_factory=list)
+
+
+def synthetic_data_eval(x_train: np.ndarray, y_train: np.ndarray,
+                        x_test: np.ndarray, y_test: np.ndarray,
+                        cfg: Optional[VAEConfig] = None, *,
+                        evaluator_epochs: int = 200,
+                        seed: int = 0) -> SyntheticEvalResult:
+    """The full real-vs-synthetic protocol on a binary tabular task."""
+    cfg = cfg or VAEConfig(input_dim=int(x_train.shape[1]))
+    synth_x, synth_y, reports = [], [], []
+    for label in np.unique(y_train):
+        rows = x_train[y_train == label]
+        params, state, rep = train_vae(rows, cfg)
+        reports.append(rep)
+        out = vae.sample(jax.random.key(seed + int(label)), params, state,
+                         len(rows), cfg.latent_dim)
+        synth_x.append(np.asarray(out))
+        synth_y.append(np.full(len(rows), label, y_train.dtype))
+    synth_x = np.concatenate(synth_x)
+    synth_y = np.concatenate(synth_y)
+
+    _, real_rep = train_classifier(x_train, y_train, x_test, y_test,
+                                   epochs=evaluator_epochs, seed=seed)
+    _, synth_rep = train_classifier(synth_x, synth_y, x_test, y_test,
+                                    epochs=evaluator_epochs, seed=seed)
+    return SyntheticEvalResult(real_rep.best_accuracy, synth_rep.best_accuracy,
+                               reports)

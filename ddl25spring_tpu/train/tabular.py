@@ -1,0 +1,83 @@
+"""Centralized tabular training: the heart-disease classifier baseline.
+
+Capability target: the reference's centralized trainer (lab/tutorial_2a/
+centralized.py:30-70) — minibatch Adam on the 4-layer `HeartDiseaseNN` MLP,
+evaluating on the test set every epoch and keeping the BEST parameters by
+test accuracy (centralized.py:51,67-70 snapshots/reloads state_dict).
+
+Also the evaluator used by the synthetic-data protocol (train on real vs
+synthetic, compare test accuracy — generative-modeling.py:165-209), which is
+this same trainer pointed at a different training set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models import tabular
+from ..ops import cross_entropy_loss
+from .batching import pad_batches
+
+
+@dataclass
+class ClassifierReport:
+    train_losses: List[float] = field(default_factory=list)   # per epoch
+    test_accuracies: List[float] = field(default_factory=list)
+    best_accuracy: float = 0.0
+    best_epoch: int = -1
+
+
+def train_classifier(x_train: np.ndarray, y_train: np.ndarray,
+                     x_test: np.ndarray, y_test: np.ndarray, *,
+                     epochs: int = 200, batch_size: int = 64, lr: float = 1e-3,
+                     hidden=(64, 32, 16), seed: int = 0,
+                     log_every: int = 0,
+                     log_fn: Callable[[str], None] = print
+                     ) -> Tuple[list, ClassifierReport]:
+    """Returns (best_params, report) — best by test accuracy, like the
+    reference's best-state_dict tracking."""
+    in_dim = int(x_train.shape[1])
+    params = tabular.init(jax.random.key(seed), in_dim, hidden)
+    optimizer = optax.adam(lr)
+    opt_state = optimizer.init(params)
+
+    (xb,), yb, mb = pad_batches([x_train.astype(np.float32)], y_train, batch_size)
+    xt, yt = jnp.asarray(x_test, jnp.float32), jnp.asarray(y_test)
+
+    def minibatch_step(carry, batch):
+        params, opt_state = carry
+        x, y, m = batch
+
+        def loss_fn(p):
+            return cross_entropy_loss(tabular.apply(p, x), y, m)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss * m.sum()
+
+    @jax.jit
+    def epoch_fn(params, opt_state):
+        (params, opt_state), losses = jax.lax.scan(
+            minibatch_step, (params, opt_state), (xb, yb, mb))
+        acc = (tabular.apply(params, xt).argmax(-1) == yt).mean()
+        return params, opt_state, losses.sum() / mb.sum(), acc
+
+    report = ClassifierReport()
+    best_params = params
+    for epoch in range(epochs):
+        params, opt_state, loss, acc = epoch_fn(params, opt_state)
+        acc = float(acc)
+        report.train_losses.append(float(loss))
+        report.test_accuracies.append(acc)
+        if acc > report.best_accuracy:
+            report.best_accuracy, report.best_epoch = acc, epoch
+            best_params = params
+        if log_every and epoch % log_every == 0:
+            log_fn(f"epoch {epoch}: loss {report.train_losses[-1]:.4f} test acc {acc:.4f}")
+    return best_params, report

@@ -1,0 +1,158 @@
+"""Vertical-FL / split-learning training harnesses.
+
+Capability targets:
+- `train_vfl` — the reference's `VFLNetwork.train_with_settings(epochs, bs,
+  ...)` joint training loop over vertically-partitioned features
+  (lab/tutorial_2b/vfl.py:53-85): per-epoch minibatch Adam, train
+  accuracy+loss per epoch, final test accuracy ≈85% on heart.csv with 4
+  parties.
+- `train_vfl_vae` — the hw2 ex3 hybrid: client encoders → concat(mu) →
+  server VAE → split synthetic latents → client decoders, joint loss
+  Σ per-client MSE + KL/batch (lab/hw02/Tea_Pula_HW2.ipynb cells 32-41,
+  total ≈4.1 at 1000 epochs).
+
+Documented deviation: the reference calls ``optimizer.zero_grad()`` once per
+EPOCH (vfl.py:62), so each minibatch step applies the running sum of all
+previous minibatch gradients of that epoch — an accumulation quirk, not a
+design choice. Here each step uses its own minibatch gradient (the intended
+semantics); convergence matches the reference's reported accuracy band.
+
+TPU-native shape: one jitted `lax.scan` over padded minibatches per epoch —
+party feature widths differ, so per-party arrays ride the scan as a tuple;
+the partial last batch is handled by masking, not dropped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..config import VFLConfig
+from ..models import vfl_nets
+from ..ops import cross_entropy_loss
+from .batching import pad_batches
+
+
+@dataclass
+class VFLReport:
+    train_losses: List[float] = field(default_factory=list)   # per epoch
+    train_accuracies: List[float] = field(default_factory=list)
+    test_accuracy: float = 0.0
+
+
+def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
+              xs_test: Sequence[np.ndarray], y_test: np.ndarray,
+              cfg: Optional[VFLConfig] = None, *,
+              log_every: int = 0,
+              log_fn: Callable[[str], None] = print) -> Tuple[dict, VFLReport]:
+    """Jointly train bottoms+top over vertically-partitioned features.
+
+    ``xs_train[i]`` is party i's feature slice [N, d_i]. Returns the trained
+    params and per-epoch train metrics + final test accuracy.
+    """
+    cfg = cfg or VFLConfig()
+    feature_dims = [int(a.shape[1]) for a in xs_train]
+    params = vfl_nets.init_vfl(jax.random.key(cfg.seed), feature_dims,
+                               bottom_out=cfg.bottom_out_dim)
+    optimizer = optax.adam(cfg.lr)
+    opt_state = optimizer.init(params)
+
+    xs_b, y_b, m_b = pad_batches(xs_train, y_train, cfg.batch_size)
+
+    def minibatch_step(carry, batch):
+        params, opt_state = carry
+        xs, y, m = batch
+
+        def loss_fn(p):
+            logits = vfl_nets.vfl_forward(p, xs)
+            return cross_entropy_loss(logits, y, m), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        correct = ((logits.argmax(-1) == y) * m).sum()
+        return (params, opt_state), (loss * m.sum(), correct, m.sum())
+
+    @jax.jit
+    def epoch_fn(params, opt_state):
+        (params, opt_state), (losses, correct, counts) = jax.lax.scan(
+            minibatch_step, (params, opt_state), (xs_b, y_b, m_b))
+        n = counts.sum()
+        return params, opt_state, losses.sum() / n, correct.sum() / n
+
+    @jax.jit
+    def test_acc(params):
+        logits = vfl_nets.vfl_forward(params, tuple(jnp.asarray(a) for a in xs_test))
+        return (logits.argmax(-1) == jnp.asarray(y_test)).mean()
+
+    report = VFLReport()
+    for epoch in range(cfg.epochs):
+        params, opt_state, loss, acc = epoch_fn(params, opt_state)
+        report.train_losses.append(float(loss))
+        report.train_accuracies.append(float(acc))
+        if log_every and epoch % log_every == 0:
+            log_fn(f"epoch {epoch}: loss {report.train_losses[-1]:.4f} "
+                   f"acc {report.train_accuracies[-1]:.4f}")
+    report.test_accuracy = float(test_acc(params))
+    return params, report
+
+
+# ------------------------------------------------------------- VFL-VAE hybrid
+
+@dataclass
+class VFLVAEReport:
+    total_losses: List[float] = field(default_factory=list)   # per epoch
+    recon_losses: List[float] = field(default_factory=list)
+    kl_losses: List[float] = field(default_factory=list)
+
+
+def train_vfl_vae(xs_train: Sequence[np.ndarray],
+                  cfg: Optional[VFLConfig] = None, *,
+                  epochs: int = 1000,
+                  client_latent: int = 4,
+                  log_every: int = 0,
+                  log_fn: Callable[[str], None] = print) -> Tuple[dict, VFLVAEReport]:
+    """Train the hw2 ex3 VFL-VAE on vertically-partitioned features.
+
+    Full-batch per epoch with a fresh reparameterization key, matching the
+    reference's training loop (Tea_Pula_HW2.ipynb cell 40; final total ≈4.10
+    = recon 3.97 + KL 0.128 with 4 clients × latent 4).
+    """
+    cfg = cfg or VFLConfig()
+    feature_dims = [int(a.shape[1]) for a in xs_train]
+    params = vfl_nets.init_vfl_vae(jax.random.key(cfg.seed), feature_dims,
+                                   client_latent=client_latent)
+    # client_latent rides the pytree as static metadata — keep it out of optax.
+    static = {"client_latent": params.pop("client_latent")}
+    optimizer = optax.adam(cfg.lr)
+    opt_state = optimizer.init(params)
+    xs = tuple(jnp.asarray(a) for a in xs_train)
+
+    @jax.jit
+    def step(params, opt_state, key):
+        def loss_fn(p):
+            recons, mu, logvar = vfl_nets.vfl_vae_forward({**p, **static}, xs, key)
+            total, recon, kl = vfl_nets.vfl_vae_loss(recons, xs, mu, logvar)
+            return total, (recon, kl)
+
+        (total, (recon, kl)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, total, recon, kl
+
+    report = VFLVAEReport()
+    key = jax.random.key(cfg.seed + 1)
+    for epoch in range(epochs):
+        key, sub = jax.random.split(key)
+        params, opt_state, total, recon, kl = step(params, opt_state, sub)
+        report.total_losses.append(float(total))
+        report.recon_losses.append(float(recon))
+        report.kl_losses.append(float(kl))
+        if log_every and epoch % log_every == 0:
+            log_fn(f"epoch {epoch}: total {report.total_losses[-1]:.4f} "
+                   f"(recon {report.recon_losses[-1]:.4f} kl {report.kl_losses[-1]:.4f})")
+    return {**params, **static}, report

@@ -1,0 +1,91 @@
+"""VFL, VFL-VAE, VAE, and centralized-tabular harness tests.
+
+Convergence targets are scaled-down versions of the reference's outcomes
+(SURVEY.md §6): VFL reaches the ~85% band on heart data over 300 epochs —
+here fewer epochs and a looser floor keep the test fast while still proving
+the joint split-training learns; the VFL-VAE total loss must decrease and
+decompose into recon+KL; the synthetic-data evaluator must be trainable on
+VAE samples.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.config import VAEConfig, VFLConfig
+from ddl25spring_tpu.data import tabular as tabdata
+from ddl25spring_tpu.train import (
+    synthetic_data_eval, train_classifier, train_vae, train_vfl, train_vfl_vae)
+
+
+@pytest.fixture(scope="module")
+def heart():
+    X, y = tabdata.load_heart()
+    feats, names = tabdata.preprocess(X)
+    xtr, ytr, xte, yte = tabdata.train_test_split(feats, y, seed=0)
+    return xtr, ytr, xte, yte, names
+
+
+def _partition(x, parts):
+    return [x[:, idx] for idx in parts]
+
+
+def test_vfl_trains_to_accuracy(heart):
+    xtr, ytr, xte, yte, names = heart
+    parts = tabdata.split_features_evenly(names, 4)
+    cfg = VFLConfig(nr_clients=4, epochs=60)
+    params, report = train_vfl(_partition(xtr, parts), ytr,
+                               _partition(xte, parts), yte, cfg)
+    # Reference band is ~85% at 300 epochs (Tea_Pula_HW2.ipynb cell 6);
+    # 60 epochs must already clear a clearly-learned floor.
+    assert report.test_accuracy > 0.75, report.test_accuracy
+    assert report.train_losses[-1] < report.train_losses[0]
+
+
+def test_vfl_partition_policies_cover_all_clients(heart):
+    *_, names = heart
+    for n_clients in (2, 6, 10):
+        parts = tabdata.split_features_with_minimum(names, n_clients, seed=1)
+        assert len(parts) == n_clients
+        assert all(len(p) >= 2 for p in parts)
+
+
+def test_vfl_vae_loss_decreases(heart):
+    xtr = heart[0]
+    names = heart[4]
+    parts = tabdata.split_features_evenly(names, 4)
+    xs = _partition(xtr[:256], parts)
+    params, report = train_vfl_vae(xs, VFLConfig(nr_clients=4), epochs=120)
+    assert report.total_losses[-1] < report.total_losses[0]
+    # total = recon + kl decomposition holds
+    np.testing.assert_allclose(
+        report.total_losses[-1],
+        report.recon_losses[-1] + report.kl_losses[-1], rtol=1e-5)
+
+
+def test_vae_trains_and_samples(heart):
+    xtr = heart[0]
+    cfg = VAEConfig(input_dim=xtr.shape[1], epochs=40)
+    params, state, report = train_vae(xtr, cfg)
+    assert report.total_losses[-1] < report.total_losses[0]
+    from ddl25spring_tpu.models import vae
+    synth = vae.sample(jax.random.key(0), params, state, 32, cfg.latent_dim)
+    assert synth.shape == (32, xtr.shape[1])
+    assert np.isfinite(np.asarray(synth)).all()
+
+
+def test_synthetic_data_eval_protocol(heart):
+    xtr, ytr, xte, yte, _ = heart
+    cfg = VAEConfig(input_dim=xtr.shape[1], epochs=30)
+    res = synthetic_data_eval(xtr[:400], ytr[:400], xte, yte, cfg,
+                              evaluator_epochs=40)
+    assert res.real_accuracy > 0.7, res.real_accuracy
+    # Synthetic-trained evaluator must be meaningfully above chance.
+    assert res.synthetic_accuracy > 0.5, res.synthetic_accuracy
+
+
+def test_centralized_classifier_best_tracking(heart):
+    xtr, ytr, xte, yte, _ = heart
+    params, report = train_classifier(xtr, ytr, xte, yte, epochs=30)
+    assert report.best_accuracy == max(report.test_accuracies)
+    assert report.best_accuracy > 0.75, report.best_accuracy
