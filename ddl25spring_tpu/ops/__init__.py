@@ -1,1 +1,2 @@
 from .losses import causal_lm_loss, cross_entropy_loss  # noqa: F401
+from .flash_attention import flash_attention  # noqa: F401

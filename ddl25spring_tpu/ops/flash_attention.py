@@ -1,0 +1,157 @@
+"""Fused causal attention (FlashAttention) as a Pallas TPU kernel.
+
+Capability/perf target: the reference computes attention inside simplellm's
+torch modules (materializing the full [T, T] score matrix per head). On TPU
+the memory-bound step is HBM traffic for those scores; this kernel streams
+K/V blocks through VMEM with the online-softmax recurrence so scores never
+leave the chip, and the matmuls hit the MXU in bf16.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- grid = (batch·heads, q_blocks, k_blocks); the LAST grid axis runs
+  sequentially on TPU, so the (m, l, acc) running statistics live in VMEM
+  scratch that persists across the k sweep for a fixed q block.
+- m/l scratch is shaped (block_q, 128) — lane-width replicated — to respect
+  the fp32 (8, 128) min tile; column values are identical across lanes.
+- Causal blocks strictly above the diagonal are skipped via `pl.when`
+  (predicated out — no FLOPs, no VMEM traffic); the diagonal block applies
+  an iota mask.
+- On non-TPU backends `interpret=True` keeps tests runnable on the virtual
+  CPU mesh; production CPU paths should use the XLA einsum attention
+  (models/llama._xla_attention) instead.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, n_k_blocks: int, scale: float,
+                  causal: bool, seq_len: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: block contributes iff its first key position can be visible to
+    # the last query position of this q block.
+    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)                     # [bq, dh]
+        k = k_ref[0].astype(jnp.float32)                     # [bk, dh]
+        v = v_ref[0].astype(jnp.float32)                     # [bk, dh]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
+            + ik * block_k
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+                + iq * block_q
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        if not causal:
+            # Zero-padded tail keys must not receive softmax mass. (With
+            # causal=True the causal mask already hides them from every real
+            # query, and padded query rows are trimmed by the wrapper.)
+            s = jnp.where(kpos < seq_len, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                                # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)                      # [bq, 1]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == n_k_blocks - 1)
+    def _finalize():
+        # Fully-masked rows (can't happen for causal q>=0) would have l=0;
+        # guard anyway so padding rows emit zeros, not NaNs.
+        l = l_ref[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None
+                    ) -> jnp.ndarray:
+    """Fused attention. q, k, v: [B, T, H, Dh] (same layout as the XLA path
+    in models/llama.attention). Returns [B, T, H, Dh].
+
+    Sequence length is padded up to a block multiple internally; with
+    ``causal=True`` the tail padding keys are masked by causality for every
+    real query, so no extra length mask is needed.
+    """
+    b, t, h, dh = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Sequence is padded to a common multiple of both block sizes so the
+    # q and k grids each tile t_pad exactly; padded keys are masked in the
+    # kernel and padded query rows are trimmed on return.
+    lcm = math.lcm(block_q, block_k)
+    t_pad = math.ceil(t / lcm) * lcm
+
+    def to_bh(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, dh)      # [BH, T, Dh]
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+        return x
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    n_q = t_pad // block_q
+    n_k = t_pad // block_k
+    scale = 1.0 / math.sqrt(dh)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, n_k_blocks=n_k,
+        scale=scale, causal=causal, seq_len=t)
+
+    if causal:
+        # Above-diagonal grid steps are predicated out in the kernel; clamp
+        # their K/V block index to the diagonal so consecutive steps reference
+        # the same block and the pipeline elides the HBM fetch entirely.
+        def kv_index(bh, iq, ik):
+            return (bh, jnp.minimum(ik, (iq * block_q + block_q - 1) // block_k), 0)
+    else:
+        def kv_index(bh, iq, ik):
+            return (bh, ik, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, dh), kv_index),
+            pl.BlockSpec((1, block_k, dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),       # m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),       # l
+            pltpu.VMEM((block_q, dh), jnp.float32),           # acc
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+    out = out[:, :t].reshape(b, h, t, dh)
+    return jnp.moveaxis(out, 1, 2)                            # [B, T, H, Dh]

@@ -17,7 +17,6 @@ each label's rows) so sampled rows inherit the class of their generator —
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
@@ -28,7 +27,7 @@ import optax
 
 from ..config import VAEConfig
 from ..models import vae
-from .tabular import ClassifierReport, train_classifier
+from .tabular import train_classifier
 
 
 @dataclass

@@ -1,0 +1,98 @@
+"""Pallas flash attention vs the XLA einsum reference.
+
+Runs in interpret mode on the CPU test mesh. Real-TPU Mosaic compilation is
+NOT covered here — compile and numerics on hardware were checked manually
+(max abs err ~2e-3 vs the XLA path, MXU bf16-pass accumulation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.config import LlamaConfig
+from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.ops import flash_attention
+
+
+def _ref_attention(q, k, v, causal=True):
+    return llama._xla_attention(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("t,dh", [(256, 48), (128, 64), (100, 32)])
+def test_flash_matches_xla_causal(t, dh):
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h = 2, 3
+    q = jax.random.normal(kq, (b, t, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, dh), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_noncausal_padded_tail():
+    """Non-block-multiple t: padded tail keys must get zero softmax mass."""
+    key = jax.random.key(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 100, 2, 32), jnp.float32)
+    k = jax.random.normal(kk, (1, 100, 2, 32), jnp.float32)
+    v = jax.random.normal(kv, (1, 100, 2, 32), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64)
+    ref = _ref_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_mismatched_blocks():
+    """block_q != block_k with t not a multiple of either: no dropped keys."""
+    key = jax.random.key(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 100, 2, 32), jnp.float32)
+    k = jax.random.normal(kk, (1, 100, 2, 32), jnp.float32)
+    v = jax.random.normal(kv, (1, 100, 2, 32), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
+    ref = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_noncausal():
+    key = jax.random.key(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 128, 2, 32), jnp.float32)
+    k = jax.random.normal(kk, (1, 128, 2, 32), jnp.float32)
+    v = jax.random.normal(kv, (1, 128, 2, 32), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64)
+    ref = _ref_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_path():
+    key = jax.random.key(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 256, 2, 48), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, 256, 2, 48), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, 256, 2, 48), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _ref_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2)
+
+
+def test_llama_forward_with_pallas_attention():
+    """attention_impl='pallas' end-to-end through the model forward."""
+    cfg = LlamaConfig(vocab_size=128, dmodel=64, num_heads=2, n_layers=2,
+                      ctx_size=64, attention_impl="pallas")
+    cfg_ref = LlamaConfig(vocab_size=128, dmodel=64, num_heads=2, n_layers=2,
+                          ctx_size=64)
+    params = llama.init_llama(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, 128)
+    out = llama.forward(params, tokens, cfg)
+    ref = llama.forward(params, tokens, cfg_ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-2, rtol=5e-2)
