@@ -24,7 +24,7 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -124,7 +124,10 @@ def _xla_attention(q, k, v, *, causal: bool, q_offset: int = 0) -> jnp.ndarray:
 
 
 def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
-              cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+              cos: jnp.ndarray, sin: jnp.ndarray,
+              attn_fn: Optional[Callable] = None) -> jnp.ndarray:
+    """``attn_fn(q, k, v) -> out`` (all [B, T, H, Dh]) overrides the attention
+    inner — the hook sequence parallelism uses to swap in ring attention."""
     b, t, d = x.shape
     h, dh = cfg.num_heads, cfg.head_dim
     q = (x @ block["wq"].astype(x.dtype)).reshape(b, t, h, dh)
@@ -132,7 +135,9 @@ def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
     v = (x @ block["wv"].astype(x.dtype)).reshape(b, t, h, dh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if cfg.attention_impl == "pallas":
+    if attn_fn is not None:
+        out = attn_fn(q, k, v)
+    elif cfg.attention_impl == "pallas":
         from ..ops.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=True)
     else:
@@ -147,8 +152,10 @@ def mlp(block: dict, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def block_apply(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
-                cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
-    x = x + attention(block, nn.rmsnorm(block["attn_norm"], x, eps=cfg.norm_eps), cfg, cos, sin)
+                cos: jnp.ndarray, sin: jnp.ndarray,
+                attn_fn: Optional[Callable] = None) -> jnp.ndarray:
+    x = x + attention(block, nn.rmsnorm(block["attn_norm"], x, eps=cfg.norm_eps),
+                      cfg, cos, sin, attn_fn)
     x = x + mlp(block, nn.rmsnorm(block["mlp_norm"], x, eps=cfg.norm_eps))
     return x
 
@@ -172,16 +179,23 @@ def embed(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
 
 
 def blocks_apply(blocks: dict, h: jnp.ndarray, cfg: LlamaConfig,
-                 positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 positions: Optional[jnp.ndarray] = None,
+                 attn_fn: Optional[Callable] = None) -> jnp.ndarray:
     """Apply a stack of blocks (leading [L] axis) via one lax.scan."""
     t = h.shape[1]
     if positions is None:
         positions = jnp.arange(t)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
-    fn = jax.checkpoint(block_apply, static_argnums=(2,)) if cfg.remat else block_apply
+
+    def apply_one(block, carry, cos, sin):
+        # cfg/attn_fn captured by closure: cfg is static config, attn_fn may
+        # close over collective primitives that must trace fresh per call.
+        return block_apply(block, carry, cfg, cos, sin, attn_fn)
+
+    fn = jax.checkpoint(apply_one) if cfg.remat else apply_one
 
     def body(carry, block):
-        return fn(block, carry, cfg, cos, sin), None
+        return fn(block, carry, cos, sin), None
 
     out, _ = lax.scan(body, h, blocks)
     return out

@@ -1,2 +1,6 @@
 from .losses import causal_lm_loss, cross_entropy_loss  # noqa: F401
-from .flash_attention import flash_attention  # noqa: F401
+
+# NOTE: the flash-attention kernel is deliberately NOT re-exported here —
+# import it from ddl25spring_tpu.ops.flash_attention. A package-level
+# re-export would either pull jax.experimental.pallas into every ops import
+# or (with a lazy __getattr__) collide with the submodule of the same name.

@@ -12,7 +12,7 @@ import pytest
 
 from ddl25spring_tpu.config import LlamaConfig
 from ddl25spring_tpu.models import llama
-from ddl25spring_tpu.ops import flash_attention
+from ddl25spring_tpu.ops.flash_attention import flash_attention
 
 
 def _ref_attention(q, k, v, causal=True):
