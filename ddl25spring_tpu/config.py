@@ -78,6 +78,23 @@ class LlamaConfig:
 
 
 @dataclass(frozen=True)
+class MoEConfig:
+    """Mixture-of-Experts tiny-Llama configuration (parity-plus: the
+    reference has no MoE/expert parallelism — SURVEY.md §2.10 marks EP
+    "Absent"). Every block's SwiGLU MLP becomes a top-k routed expert bank;
+    attention/embedding stay the LlamaConfig canonical shapes."""
+
+    base: LlamaConfig = field(default_factory=LlamaConfig)
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25  # expert capacity = ceil(N·k/E · factor)
+    aux_loss_coef: float = 0.01    # load-balance loss weight (Switch-style)
+
+    def replace(self, **kw) -> "MoEConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """LLM training loop configuration (reference: primer/intro.py:22-23 —
     Adam lr 8e-4, 5000 iterations, batch 3 per rank, seq 256)."""

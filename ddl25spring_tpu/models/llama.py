@@ -125,14 +125,23 @@ def _xla_attention(q, k, v, *, causal: bool, q_offset: int = 0) -> jnp.ndarray:
 
 def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
               cos: jnp.ndarray, sin: jnp.ndarray,
-              attn_fn: Optional[Callable] = None) -> jnp.ndarray:
+              attn_fn: Optional[Callable] = None,
+              tp_axis: Optional[str] = None) -> jnp.ndarray:
     """``attn_fn(q, k, v) -> out`` (all [B, T, H, Dh]) overrides the attention
-    inner — the hook sequence parallelism uses to swap in ring attention."""
+    inner — the hook sequence parallelism uses to swap in ring attention.
+
+    ``tp_axis`` enables Megatron-style tensor parallelism under shard_map:
+    wq/wk/wv are column-sharded (local heads), wo row-sharded, and the output
+    projection's partial sum is psum-ed over the axis. Head count is inferred
+    from the local weight shapes, so the same code runs sharded or full.
+    """
     b, t, d = x.shape
-    h, dh = cfg.num_heads, cfg.head_dim
-    q = (x @ block["wq"].astype(x.dtype)).reshape(b, t, h, dh)
-    k = (x @ block["wk"].astype(x.dtype)).reshape(b, t, h, dh)
-    v = (x @ block["wv"].astype(x.dtype)).reshape(b, t, h, dh)
+    dh = cfg.head_dim
+    q_mat = x @ block["wq"].astype(x.dtype)
+    h_local = q_mat.shape[-1] // dh                  # = num_heads / tp_size
+    q = q_mat.reshape(b, t, h_local, dh)
+    k = (x @ block["wk"].astype(x.dtype)).reshape(b, t, h_local, dh)
+    v = (x @ block["wv"].astype(x.dtype)).reshape(b, t, h_local, dh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if attn_fn is not None:
@@ -142,21 +151,31 @@ def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
         out = flash_attention(q, k, v, causal=True)
     else:
         out = _xla_attention(q, k, v, causal=True)
-    return out.reshape(b, t, d) @ block["wo"].astype(x.dtype)
+    y = out.reshape(b, t, h_local * dh) @ block["wo"].astype(x.dtype)
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)                     # combine head groups
+    return y
 
 
-def mlp(block: dict, x: jnp.ndarray) -> jnp.ndarray:
+def mlp(block: dict, x: jnp.ndarray,
+        tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """SwiGLU MLP. With ``tp_axis``: w_gate/w_up column-sharded (local ffn
+    slice), w_down row-sharded, partial output psum-ed over the axis."""
     gate = jax.nn.silu(x @ block["w_gate"].astype(x.dtype))
     up = x @ block["w_up"].astype(x.dtype)
-    return (gate * up) @ block["w_down"].astype(x.dtype)
+    y = (gate * up) @ block["w_down"].astype(x.dtype)
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    return y
 
 
 def block_apply(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
                 cos: jnp.ndarray, sin: jnp.ndarray,
-                attn_fn: Optional[Callable] = None) -> jnp.ndarray:
+                attn_fn: Optional[Callable] = None,
+                tp_axis: Optional[str] = None) -> jnp.ndarray:
     x = x + attention(block, nn.rmsnorm(block["attn_norm"], x, eps=cfg.norm_eps),
-                      cfg, cos, sin, attn_fn)
-    x = x + mlp(block, nn.rmsnorm(block["mlp_norm"], x, eps=cfg.norm_eps))
+                      cfg, cos, sin, attn_fn, tp_axis)
+    x = x + mlp(block, nn.rmsnorm(block["mlp_norm"], x, eps=cfg.norm_eps), tp_axis)
     return x
 
 
@@ -180,7 +199,8 @@ def embed(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
 
 def blocks_apply(blocks: dict, h: jnp.ndarray, cfg: LlamaConfig,
                  positions: Optional[jnp.ndarray] = None,
-                 attn_fn: Optional[Callable] = None) -> jnp.ndarray:
+                 attn_fn: Optional[Callable] = None,
+                 tp_axis: Optional[str] = None) -> jnp.ndarray:
     """Apply a stack of blocks (leading [L] axis) via one lax.scan."""
     t = h.shape[1]
     if positions is None:
@@ -190,7 +210,7 @@ def blocks_apply(blocks: dict, h: jnp.ndarray, cfg: LlamaConfig,
     def apply_one(block, carry, cos, sin):
         # cfg/attn_fn captured by closure: cfg is static config, attn_fn may
         # close over collective primitives that must trace fresh per call.
-        return block_apply(block, carry, cfg, cos, sin, attn_fn)
+        return block_apply(block, carry, cfg, cos, sin, attn_fn, tp_axis)
 
     fn = jax.checkpoint(apply_one) if cfg.remat else apply_one
 

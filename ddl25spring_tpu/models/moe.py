@@ -1,0 +1,203 @@
+"""Mixture-of-Experts tiny-Llama with capacity-based top-k routing.
+
+Parity-plus capability: the reference has no MoE (SURVEY.md §2.10 marks
+expert parallelism "Absent"). This is the TPU-native formulation: routing is
+expressed as dense one-hot dispatch/combine einsums over a fixed expert
+capacity — static shapes, no gather/scatter of ragged token lists — so XLA
+tiles every expert matmul onto the MXU and `parallel.ep` can shard the
+expert bank over an ``expert`` mesh axis with one psum to combine.
+
+Shapes (N = B·T flattened tokens, E experts, C capacity, D model, F ffn):
+- router logits  [N, E]  → top-k probs, renormalized over the chosen k.
+- dispatch       [N, E, C] one-hot: token n occupies slot c of expert e.
+  Tokens beyond an expert's capacity are DROPPED (their combine weight is 0
+  and the residual stream passes them through unchanged — Switch semantics).
+- expert_in = einsum('nec,nd->ecd') ; expert MLP maps [E, C, D] → [E, C, D];
+  combine = einsum('nec,ecd->nd') with probabilities folded into dispatch.
+
+The auxiliary load-balance loss is the Switch/GShard form:
+``E · Σ_e fraction_tokens(e) · mean_router_prob(e)``, minimized at uniform
+routing; forward returns it alongside the logits.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import MoEConfig
+from .. import nn
+from . import llama
+
+
+# ------------------------------------------------------------------ init
+
+def init_moe_block(key, cfg: MoEConfig) -> dict:
+    """One MoE transformer block: llama attention + routed expert MLPs."""
+    base = cfg.base
+    dt = jnp.dtype(base.param_dtype)
+    d, f, e = base.dmodel, base.ffn_dim, cfg.n_experts
+    ks = jax.random.split(key, 9)
+    std = 0.02
+    out_std = 0.02 / (2 * base.n_layers) ** 0.5
+    normal = lambda k, shape, s: jax.random.normal(k, shape, dt) * jnp.asarray(s, dt)
+    return {
+        "attn_norm": nn.rmsnorm_init(d, dt),
+        "wq": normal(ks[0], (d, d), std),
+        "wk": normal(ks[1], (d, d), std),
+        "wv": normal(ks[2], (d, d), std),
+        "wo": normal(ks[3], (d, d), out_std),
+        "mlp_norm": nn.rmsnorm_init(d, dt),
+        "router": normal(ks[4], (d, e), std),
+        "w_gate": normal(ks[5], (e, d, f), std),
+        "w_up": normal(ks[6], (e, d, f), std),
+        "w_down": normal(ks[7], (e, f, d), out_std),
+    }
+
+
+def init_moe_llama(key, cfg: MoEConfig) -> dict:
+    """Full MoE model; same embed/final_norm/lm_head structure as llama so
+    checkpointing and stage-splitting tooling applies unchanged."""
+    base = cfg.base
+    dt = jnp.dtype(base.param_dtype)
+    k_embed, k_blocks, k_head = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, base.n_layers)
+    blocks = jax.vmap(lambda k: init_moe_block(k, cfg))(block_keys)
+    normal = lambda k, shape: jax.random.normal(k, shape, dt) * jnp.asarray(0.02, dt)
+    return {
+        "embed": normal(k_embed, (base.vocab_size, base.dmodel)),
+        "blocks": blocks,
+        "final_norm": nn.rmsnorm_init(base.dmodel, dt),
+        "lm_head": normal(k_head, (base.dmodel, base.vocab_size)),
+    }
+
+
+def capacity(n_tokens: int, cfg: MoEConfig) -> int:
+    c = math.ceil(n_tokens * cfg.top_k / cfg.n_experts * cfg.capacity_factor)
+    return max(c, cfg.top_k)
+
+
+# ------------------------------------------------------------------ routing
+
+def route(router_logits: jnp.ndarray, cfg: MoEConfig, cap: int
+          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k dispatch. router_logits [N, E] →
+    (dispatch [N, E, C] binary, combine [N, E, C] prob-weighted, aux loss).
+
+    dispatch[n, e, c] = 1 iff token n occupies slot c of expert e — experts
+    see the UNSCALED token x (Switch semantics); combine = dispatch · prob is
+    applied only on the way out. Slot assignment is first-come-first-served
+    by token order via a per-expert cumulative count; overflowing tokens
+    contribute nothing (their residual stream passes through unchanged).
+    """
+    n, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_p, top_idx = lax.top_k(probs, cfg.top_k)               # [N, k]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    # Aux load-balance loss uses the pre-normalization router probabilities
+    # and the realized assignment fractions (Switch eq. 4).
+    assign1 = jax.nn.one_hot(top_idx[:, 0], e)                 # primary expert
+    frac_tokens = assign1.mean(0)
+    frac_probs = probs.mean(0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    # Slot positions: for the flattened (k·N) assignment sequence, each
+    # token's slot within its expert = #prior assignments to that expert.
+    # Order: all tokens' 1st choices, then 2nd choices (priority to 1st).
+    flat_idx = top_idx.T.reshape(-1)                           # [k·N]
+    onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)      # [k·N, E]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot        # exclusive
+    slot = (pos_in_expert * onehot).sum(-1)                    # [k·N]
+    keep = slot < cap
+    slot_oh = jax.nn.one_hot(slot, cap) * keep[:, None]        # [k·N, C]
+    # disp[k·N, E, C] → fold k back onto tokens; a (token, expert, slot)
+    # triple is unique, so summing over k keeps dispatch binary.
+    disp = onehot[:, :, None] * slot_oh[:, None, :]            # [k·N, E, C]
+    disp = disp.reshape(cfg.top_k, n, e, cap)
+    weights = top_p.T.reshape(cfg.top_k, n, 1, 1)
+    dispatch = disp.sum(0)                                     # [N, E, C]
+    combine = (disp * weights).sum(0)                          # [N, E, C]
+    return dispatch, combine, aux
+
+
+def moe_mlp(block: dict, x: jnp.ndarray, cfg: MoEConfig,
+            expert_axis: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed expert MLP. x [B, T, D] → ([B, T, D], aux loss).
+
+    Under ``expert_axis`` (shard_map EP): the expert bank's leading axis is
+    the local slice; routing runs replicated against ALL experts (the router
+    is tiny), each shard processes its local experts' slots, and the combine
+    is a psum over the axis.
+    """
+    b, t, d = x.shape
+    xf = x.reshape(b * t, d)
+    logits = xf @ block["router"].astype(x.dtype)              # [N, E_global]
+    e_local = block["w_gate"].shape[0]
+    cap = capacity(b * t, cfg)
+    dispatch, combine, aux = route(logits, cfg, cap)           # [N, E, C] ×2
+    if expert_axis is not None:
+        shard = lax.axis_index(expert_axis)
+        dispatch = lax.dynamic_slice_in_dim(
+            dispatch, shard * e_local, e_local, axis=1)        # local experts
+        combine = lax.dynamic_slice_in_dim(
+            combine, shard * e_local, e_local, axis=1)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)        # [E_l, C, D]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                  block["w_gate"].astype(x.dtype)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, block["w_up"].astype(x.dtype))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up,
+                            block["w_down"].astype(x.dtype))
+    y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    if expert_axis is not None:
+        y = lax.psum(y, expert_axis)
+    return y.reshape(b, t, d), aux
+
+
+# ------------------------------------------------------------------ forward
+
+def moe_block_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
+                    cos: jnp.ndarray, sin: jnp.ndarray,
+                    expert_axis: Optional[str] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    base = cfg.base
+    x = x + llama.attention(
+        block, nn.rmsnorm(block["attn_norm"], x, eps=base.norm_eps),
+        base, cos, sin)
+    y, aux = moe_mlp(block, nn.rmsnorm(block["mlp_norm"], x, eps=base.norm_eps),
+                     cfg, expert_axis)
+    return x + y, aux
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
+            expert_axis: Optional[str] = None
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, T] → (logits [B, T, V], total aux loss over blocks)."""
+    base = cfg.base
+    h = llama.embed(params, tokens, base)
+    positions = jnp.arange(tokens.shape[1])
+    cos, sin = llama.rope_angles(positions, base.head_dim, base.rope_theta)
+
+    def apply_one(block, h, cos, sin):
+        return moe_block_apply(block, h, cfg, cos, sin, expert_axis)
+
+    fn = jax.checkpoint(apply_one) if base.remat else apply_one
+
+    def body(carry, block):
+        h, aux_sum = carry
+        h, aux = fn(block, h, cos, sin)
+        return (h, aux_sum + aux), None
+
+    (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                           params["blocks"])
+    return llama.head(params, h, base), aux
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))

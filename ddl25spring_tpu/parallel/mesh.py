@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-AXES = ("data", "stage", "model", "seq")  # canonical axis order
+AXES = ("data", "stage", "model", "seq", "expert")  # canonical axis order
 
 
 def make_mesh(axis_sizes: Optional[Dict[str, int]] = None, *,
@@ -59,6 +59,15 @@ def axis_size(mesh: Mesh, name: str) -> int:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch) -> jax.Array:
+    """Place a host batch with its leading axis sharded over ``data`` (when
+    the mesh has a data axis) and replicated over every other axis — the one
+    batch layout all parallelism modes here share (PP stages, TP/EP shards
+    and SP windows each read the full local batch)."""
+    spec = P("data") if mesh.shape.get("data", 1) > 1 else P()
+    return jax.device_put(batch, NamedSharding(mesh, spec))
 
 
 def sharded(mesh: Mesh, *spec) -> NamedSharding:

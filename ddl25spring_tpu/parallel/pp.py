@@ -51,23 +51,41 @@ from .dp import TrainState
 
 # ------------------------------------------------------------- param layout
 
-def param_specs(params: dict) -> dict:
+from .tp import _COL as _TP_COL, _ROW as _TP_ROW  # one source of truth for
+# which block leaves are column- vs row-sharded under tensor parallelism.
+
+
+def param_specs(params: dict, tp: bool = False) -> dict:
     """PartitionSpecs for a stacked-block Llama param tree on a pipeline mesh.
 
     ``blocks`` (leading [n_layers] axis) shards over ``stage`` — each stage
     holds its contiguous slice of layers, the SPMD analog of simplellm's
-    First/Stage/Last per-rank modules. Embedding/head/final-norm stay
-    replicated: only the first/last stage *reads* them, and their gradients
-    are psum-ed back to all stages so the replicated update is identical.
+    First/Stage/Last per-rank modules. With ``tp`` the block weight matrices
+    additionally shard over ``model`` in the Megatron layout (parallel.tp).
+    Embedding/head/final-norm stay replicated: only the first/last stage
+    *reads* them, and their gradients are psum-ed back to all stages so the
+    replicated update is identical.
     """
-    return {
-        k: jax.tree.map(lambda _: P("stage") if k == "blocks" else P(), v)
-        for k, v in params.items()
-    }
+    def block_leaf_spec(name):
+        if tp and name in _TP_COL:
+            return P("stage", None, "model")
+        if tp and name in _TP_ROW:
+            return P("stage", "model", None)
+        return P("stage")
+
+    specs = {}
+    for k, v in params.items():
+        if k == "blocks":
+            specs[k] = {name: jax.tree.map(lambda _, s=block_leaf_spec(name): s,
+                                           leaf)
+                        for name, leaf in v.items()}
+        else:
+            specs[k] = jax.tree.map(lambda _: P(), v)
+    return specs
 
 
 def shard_params(mesh: Mesh, params: dict) -> dict:
-    specs = param_specs(params)
+    specs = param_specs(params, tp=mesh.shape.get("model", 1) > 1)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
 
@@ -85,17 +103,23 @@ def init_state(mesh: Mesh, params: dict, optimizer: optax.GradientTransformation
 
 def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                             n_stages: int, n_microbatches: int,
-                            has_data_axis: bool) -> Tuple[jnp.ndarray, dict]:
+                            has_data_axis: bool,
+                            tp: int = 1) -> Tuple[jnp.ndarray, dict]:
     """Per-device body (runs under shard_map): GPipe forward over ticks,
     grads via autodiff, cross-stage/data reductions.
 
     ``params["blocks"]`` is the LOCAL stage slice [n_layers/n_stages, ...];
     ``tokens`` is the local data shard [B_local, T] with
-    B_local = n_microbatches · microbatch_size.
+    B_local = n_microbatches · microbatch_size. With ``tp > 1`` the block
+    weights are additionally model-sharded (Megatron; see parallel.tp) and
+    the loss is scaled by 1/tp under differentiation — every model shard
+    seeds an identical loss replica, and the in-forward psums (transpose:
+    psum) would otherwise count each weight path tp times.
     """
     stage = lax.axis_index("stage")
     is_first = stage == 0
     is_last = stage == n_stages - 1
+    tp_axis = "model" if tp > 1 else None
     b, t = tokens.shape
     assert b % n_microbatches == 0, (b, n_microbatches)
     mb = b // n_microbatches
@@ -111,7 +135,7 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             tok_in = tokens_mb[jnp.clip(i, 0, n_microbatches - 1)]
             x_in = jnp.where(is_first[..., None, None, None],
                              llama.embed(p, tok_in, cfg), x_prev)
-            h = llama.blocks_apply(p["blocks"], x_in, cfg)
+            h = llama.blocks_apply(p["blocks"], x_in, cfg, tp_axis=tp_axis)
             # Last stage: microbatch (i - (n_stages-1)) exits the pipe here.
             out_i = i - (n_stages - 1)
             tok_out = tokens_mb[jnp.clip(out_i, 0, n_microbatches - 1)]
@@ -128,19 +152,34 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         x0 = jnp.zeros((mb, t, cfg.dmodel), jnp.dtype(cfg.dtype))
         (_, loss_sum), _ = lax.scan(
             tick, (x0, jnp.zeros((), jnp.float32)), jnp.arange(n_ticks))
-        # LOCAL loss: nonzero only on the last stage. Do NOT psum here — the
-        # backward program is itself SPMD (ppermute transposes hop the
-        # cotangent back up the ring), so every stage's grads are reached
-        # from the last stage's seed alone; psum-ing the loss first would
-        # seed all n_stages replicas and count each path n_stages times.
-        return loss_sum / n_microbatches
+        # LOCAL loss: nonzero only on the last stage. Do NOT psum over
+        # ``stage`` here — the backward program is itself SPMD (ppermute
+        # transposes hop the cotangent back up the ring), so every stage's
+        # grads are reached from the last stage's seed alone; psum-ing the
+        # loss first would seed all n_stages replicas and count each path
+        # n_stages times. The 1/tp scaling is the model-axis counterpart.
+        return loss_sum / n_microbatches / tp
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
-    loss = lax.psum(loss, "stage")  # broadcast the value for reporting
-    # Replicated leaves (embed/head/final_norm) got grads only on the stage
-    # that read them — psum makes every stage apply the identical update.
-    grads = {k: (v if k == "blocks" else jax.tree.map(lambda g: lax.psum(g, "stage"), v))
-             for k, v in grads.items()}
+    loss = lax.psum(loss, "stage") * tp  # broadcast + undo 1/tp for reporting
+
+    def reduce_grad(name, g):
+        # Block weight matrices under TP are sharded over ``model`` — their
+        # local grads are complete. Everything else replicated over ``model``
+        # gets partial grads from each shard: psum. Leaves outside ``blocks``
+        # (embed/head/final_norm) are also replicated over ``stage`` and got
+        # grads only on the stage that read them: psum over ``stage`` too.
+        if tp_axis is not None and name not in _TP_COL | _TP_ROW:
+            g = jax.tree.map(lambda x: lax.psum(x, tp_axis), g)
+        return g
+
+    grads = {
+        k: ({name: reduce_grad(name, g) for name, g in v.items()}
+            if k == "blocks"
+            else jax.tree.map(lambda g: lax.psum(g, "stage"),
+                              reduce_grad(k, v)))
+        for k, v in grads.items()
+    }
     if has_data_axis:
         # The DP×PP cross-pipeline sync — for ALL stages, not just stage 0
         # (the reference's [0,3]-only allreduce is a recorded bug).
@@ -155,20 +194,22 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
 
     ``n_microbatches=1`` degenerates to the reference's naive staged pipeline
     (intro_PP_1F1B.py); ``>1`` is the homework_1_b1 GPipe schedule; a mesh
-    with ``data > 1`` is the homework_1_b2 DP×PP topology.
+    with ``data > 1`` is the homework_1_b2 DP×PP topology; adding a
+    ``model`` axis gives the full 3-D DP×PP×TP layout.
 
     Returns ``step(state, tokens) -> (state, loss)`` where tokens is the
     global [B, T] batch, B divisible by data_size · n_microbatches.
     """
     n_stages = mesh.shape["stage"]
     has_data = mesh.shape.get("data", 1) > 1
+    tp = mesh.shape.get("model", 1)
 
     def sharded_grads(params, tokens):
         return _pipeline_loss_and_grad(params, tokens, cfg, n_stages,
-                                       n_microbatches, has_data)
+                                       n_microbatches, has_data, tp)
 
     def step(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
-        specs = param_specs(state.params)
+        specs = param_specs(state.params, tp=tp > 1)
         loss, grads = jax.shard_map(
             sharded_grads, mesh=mesh,
             in_specs=(specs, P("data") if has_data else P()),
@@ -182,9 +223,4 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     return jax.jit(step, donate_argnums=(0,))
 
 
-def shard_batch(mesh: Mesh, tokens) -> jax.Array:
-    """Place a [B, T] host batch: leading axis sharded over ``data`` (if
-    present), replicated over ``stage`` — every stage sees the full local
-    batch, stage 0 embeds it, the last stage scores it."""
-    spec = P("data") if mesh.shape.get("data", 1) > 1 else P()
-    return jax.device_put(tokens, NamedSharding(mesh, spec))
+from .mesh import shard_batch  # noqa: E402,F401  (shared batch placement)

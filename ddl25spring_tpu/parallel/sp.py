@@ -202,6 +202,4 @@ def make_sp_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def shard_batch(mesh: Mesh, tokens) -> jax.Array:
-    spec = P("data") if mesh.shape.get("data", 1) > 1 else P()
-    return jax.device_put(tokens, NamedSharding(mesh, spec))
+from .mesh import shard_batch  # noqa: E402,F401  (shared batch placement)

@@ -1,0 +1,167 @@
+"""Tensor (model) parallelism: Megatron-style sharded transformer blocks.
+
+The reference has NO tensor parallelism — its closest analog is the VFL
+bottom/top model split (SURVEY.md §2.10 marks TP "Absent", optional
+parity-plus). This module adds it TPU-first: attention heads and the SwiGLU
+hidden dimension are sharded over a ``model`` mesh axis, the two row-sharded
+projections (wo, w_down) produce partial sums, and one ``lax.psum`` per
+sub-layer combines them over ICI — the classic Megatron f/g collective
+pattern, expressed through shard_map.
+
+Sharding layout (per block; leading [n_layers] axis never sharded here):
+- wq, wk, wv:      [L, D, D]  column-sharded  P(None, None, "model")
+  → each device computes num_heads / tp local heads end-to-end.
+- wo:              [L, D, D]  row-sharded     P(None, "model", None)
+  → partial [B,T,D] outputs, psum over "model" (inside llama.attention).
+- w_gate, w_up:    [L, D, F]  column-sharded; w_down [L, F, D] row-sharded,
+  psum inside llama.mlp.
+- norms, embedding, lm_head: replicated (their grads are psum-ed instead).
+
+Gradient accounting: the per-shard loss is scaled by 1/tp before
+differentiation. Every shard's loss copy depends on every shard's weight
+slice (through the psums), so differentiating the unscaled replicated loss
+would count each path tp times; with the 1/tp scaling, sharded-leaf grads
+come out exact locally and replicated-leaf grads become exact after a psum
+over ``model``. Composes with data parallelism on a ``(data, model)`` mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import LlamaConfig
+from ..models import llama
+from ..ops import causal_lm_loss
+from .dp import TrainState
+
+_COL = {"wq", "wk", "wv", "w_gate", "w_up"}   # shard last dim (output cols)
+_ROW = {"wo", "w_down"}                        # shard middle dim (input rows)
+
+
+def param_specs(params: dict) -> dict:
+    """Megatron PartitionSpecs for the stacked-block Llama tree."""
+    def block_spec(name):
+        def spec(_):
+            if name in _COL:
+                return P(None, None, "model")
+            if name in _ROW:
+                return P(None, "model", None)
+            return P()
+        return spec
+
+    specs = {}
+    for k, v in params.items():
+        if k == "blocks":
+            specs[k] = {name: jax.tree.map(block_spec(name), leaf)
+                        for name, leaf in v.items()}
+        else:
+            specs[k] = jax.tree.map(lambda _: P(), v)
+    return specs
+
+
+def shard_params(mesh: Mesh, params: dict) -> dict:
+    specs = param_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def init_state(mesh: Mesh, params: dict,
+               optimizer: optax.GradientTransformation) -> TrainState:
+    params = shard_params(mesh, params)
+    opt_state = jax.jit(optimizer.init)(params)
+    step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    return TrainState(params, opt_state, step)
+
+
+def _tp_loss(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+             tp: int) -> jnp.ndarray:
+    """Per-shard body: full loss / tp (see module docstring on why /tp)."""
+    h = llama.embed(params, tokens, cfg)
+    h = llama.blocks_apply(params["blocks"], h, cfg, tp_axis="model")
+    logits = llama.head(params, h, cfg)
+    return causal_lm_loss(logits, tokens) / tp
+
+
+def _sharded_mask(grads: dict) -> dict:
+    """Bool pytree marking leaves that are model-sharded (complete locally)
+    vs replicated (partial grads needing a psum over ``model``)."""
+    return {
+        outer: ({name: jax.tree.map(lambda _: name in _COL or name in _ROW, leaf)
+                 for name, leaf in v.items()} if outer == "blocks"
+                else jax.tree.map(lambda _: False, v))
+        for outer, v in grads.items()
+    }
+
+
+def make_tp_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
+                       mesh: Mesh) -> Callable:
+    """jit-compiled train step on a ``(data?, model)`` mesh.
+
+    ``step(state, tokens)``: tokens [B, T] sharded over ``data`` if present,
+    replicated over ``model`` (every TP shard sees the full local batch).
+    The grad computation runs under shard_map (explicit psums); the optimizer
+    update runs at jit level where GSPMD keeps opt-state shardings aligned
+    with the param shardings (same split as parallel.pp.make_pipeline_step).
+    """
+    tp = mesh.shape["model"]
+    has_data = mesh.shape.get("data", 1) > 1
+
+    def sharded_grads(params: dict, tokens):
+        loss, grads = jax.value_and_grad(_tp_loss)(params, tokens, cfg, tp)
+        mask = _sharded_mask(grads)
+        grads = jax.tree.map(
+            lambda g, s: g if s else lax.psum(g, "model"), grads, mask)
+        loss = loss * tp                          # undo the 1/tp scaling
+        if has_data:
+            grads = lax.pmean(grads, "data")
+            loss = lax.pmean(loss, "data")
+        return loss, grads
+
+    def step(state: TrainState, tokens):
+        pspecs = param_specs(state.params)
+        loss, grads = jax.shard_map(
+            sharded_grads, mesh=mesh,
+            in_specs=(pspecs, P("data") if has_data else P()),
+            out_specs=(P(), pspecs),
+            check_vma=False,
+        )(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def tp_forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+               mesh: Mesh) -> jnp.ndarray:
+    """Full logits via tensor-parallel forward (tests/eval); cached on
+    (cfg, mesh)."""
+    return _tp_forward_fn(cfg, mesh)(params, tokens)
+
+
+@functools.cache
+def _tp_forward_fn(cfg: LlamaConfig, mesh: Mesh) -> Callable:
+    def body(params, tokens):
+        h = llama.embed(params, tokens, cfg)
+        h = llama.blocks_apply(params["blocks"], h, cfg, tp_axis="model")
+        return llama.head(params, h, cfg)
+
+    def fn(params, tokens):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs(params), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(params, tokens)
+
+    return jax.jit(fn)
+
+
+from .mesh import shard_batch  # noqa: E402,F401  (shared batch placement)

@@ -87,6 +87,24 @@ def test_dp_pp_matches_single_device(devices):
     _assert_trees_close(jax.device_get(state.params), jax.device_get(ref_params), 2e-5)
 
 
+def test_dp_pp_tp_matches_single_device(devices):
+    """Full 3-D mesh (data=2, stage=2, model=2): DP×PP×TP in one step."""
+    params, tokens = _params_and_tokens()
+    optimizer = optax.sgd(0.1)
+    ref_loss, ref_params = _reference_step(params, tokens, optimizer, 2)
+
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2}, devices=devices)
+    state = pp.init_state(mesh, params, optimizer)
+    from jax.sharding import PartitionSpec as P
+    assert state.params["blocks"]["wq"].sharding.spec == P("stage", None, "model")
+    assert state.params["blocks"]["wo"].sharding.spec == P("stage", "model", None)
+    step = pp.make_pipeline_step(CFG, optimizer, mesh, n_microbatches=2)
+    state, loss = step(state, pp.shard_batch(mesh, tokens))
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    _assert_trees_close(jax.device_get(state.params), jax.device_get(ref_params), 2e-5)
+
+
 def test_stage_split_roundtrip():
     params, _ = _params_and_tokens()
     stages = llama.split_stages(params, 4)
