@@ -35,6 +35,34 @@ def init_state(params, optimizer: optax.GradientTransformation) -> TrainState:
     return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
 
 
+def sharded_opt_init(mesh: Mesh, params, optimizer: optax.GradientTransformation,
+                     param_specs):
+    """``optimizer.init`` with the optimizer state placed CORRECTLY on the
+    mesh: moment subtrees (anything tree-isomorphic to params, e.g. adam's
+    mu/nu) inherit the param PartitionSpecs; scalars (count) replicate.
+
+    Plain ``jax.jit(optimizer.init)(params)`` does NOT do this — absent
+    out_shardings it commits every output to one device, silently wasting
+    HBM on what should be sharded moments.
+    """
+    pstruct = jax.tree.structure(params)
+
+    def is_params_like(node):
+        try:
+            return jax.tree.structure(node) == pstruct
+        except Exception:
+            return False
+
+    def shard_of(node):
+        if is_params_like(node):
+            return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
+        return jax.tree.map(lambda _: NamedSharding(mesh, P()), node)
+
+    abstract = jax.eval_shape(optimizer.init, params)
+    out_shardings = jax.tree.map(shard_of, abstract, is_leaf=is_params_like)
+    return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
+
+
 def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                                mesh: Mesh) -> Callable:
     """jit-compiled SPMD step: local grads -> pmean over ``data`` -> update.

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import MoEConfig
 from ..models import moe
 from ..ops import causal_lm_loss
-from .dp import TrainState
+from .dp import TrainState, sharded_opt_init
 
 _EXPERT_LEAVES = {"w_gate", "w_up", "w_down"}   # leading [L, E, ...] axis
 
@@ -57,7 +57,7 @@ def shard_params(mesh: Mesh, params: dict) -> dict:
 def init_state(mesh: Mesh, params: dict,
                optimizer: optax.GradientTransformation) -> TrainState:
     params = shard_params(mesh, params)
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_state = sharded_opt_init(mesh, params, optimizer, param_specs(params))
     step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
     return TrainState(params, opt_state, step)
 

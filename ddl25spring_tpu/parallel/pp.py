@@ -46,7 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import LlamaConfig
 from ..models import llama
 from ..ops import causal_lm_loss
-from .dp import TrainState
+from .dp import TrainState, sharded_opt_init
 
 
 # ------------------------------------------------------------- param layout
@@ -91,10 +91,12 @@ def shard_params(mesh: Mesh, params: dict) -> dict:
 
 
 def init_state(mesh: Mesh, params: dict, optimizer: optax.GradientTransformation) -> TrainState:
-    """Shard params over the pipeline mesh and build matching-sharded opt
-    state (optimizer.init under jit inherits operand shardings via GSPMD)."""
+    """Shard params over the pipeline mesh; optimizer moments are explicitly
+    placed with the param specs via dp.sharded_opt_init (a plain jitted
+    optimizer.init would commit the whole opt state to one device)."""
     params = shard_params(mesh, params)
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_state = sharded_opt_init(mesh, params, optimizer,
+                                 param_specs(params, tp=mesh.shape.get("model", 1) > 1))
     step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
     return TrainState(params, opt_state, step)
 

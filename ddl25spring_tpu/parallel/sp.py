@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import LlamaConfig
 from ..models import llama
-from .dp import TrainState
+from .dp import TrainState, sharded_opt_init
 
 _NEG_INF = -1e30
 
@@ -163,7 +163,8 @@ def init_state(mesh: Mesh, params: dict,
     """Params replicated (sequence parallelism shards activations, not
     weights); see parallel.tp for weight sharding."""
     params = jax.device_put(params, NamedSharding(mesh, P()))
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_state = sharded_opt_init(mesh, params, optimizer,
+                                 jax.tree.map(lambda _: P(), params))
     step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
     return TrainState(params, opt_state, step)
 

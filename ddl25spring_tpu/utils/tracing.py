@@ -1,0 +1,138 @@
+"""Tracing, profiling, and result persistence.
+
+The reference's observability is hand-rolled perf_counter spans accumulated
+into RunResult phases (reference: lab/tutorial_1a/hfl_complete.py:350-358,
+369-371) plus shell-level `$SECONDS` prints (homework_1_b1.sh:3,13) and CSV
+dumps from notebooks (lab/hw03/Tea_Pula_03.ipynb cell 11). This module is
+the framework equivalent, plus the TPU-native layer the reference lacks:
+`jax.profiler` device traces viewable in TensorBoard/Perfetto.
+
+- ``Spans``: named wall-clock accumulators (setup/update/aggregate phases).
+- ``device_trace``: context manager around jax.profiler.trace.
+- ``StepTimer``: per-step timing with proper block_until_ready semantics —
+  async dispatch makes naive perf_counter spans lie on TPU.
+- ``ResultSink``: append experiment records (RunResult or dicts) to CSV.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import csv
+import os
+import time
+from collections import defaultdict
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+
+
+class Spans:
+    """Named wall-clock accumulators, the RunResult phase-accounting helper.
+
+    >>> spans = Spans()
+    >>> with spans("update"):
+    ...     do_work()
+    >>> spans.total("update")
+    """
+
+    def __init__(self):
+        self._acc: Dict[str, float] = defaultdict(float)
+        self._count: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] += time.perf_counter() - t0
+            self._count[name] += 1
+
+    def total(self, name: str) -> float:
+        return self._acc[name]
+
+    def count(self, name: str) -> int:
+        return self._count[name]
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self._acc)
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._count.clear()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler device trace (XLA ops, HBM, ICI) → TensorBoard-readable
+    trace in ``log_dir``. The TPU-native upgrade of the reference's
+    perf_counter-only accounting (SURVEY.md §5.1)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Per-step timing that is honest under async dispatch: ``tick`` blocks
+    on the step's outputs before reading the clock."""
+
+    def __init__(self):
+        self.times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def tick(self, *outputs) -> float:
+        for out in outputs:
+            jax.block_until_ready(out)
+        now = time.perf_counter()
+        dt = now - (self._t0 if self._t0 is not None else now)
+        self.times.append(dt)
+        self._t0 = now
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / max(len(self.times), 1)
+
+
+class ResultSink:
+    """Append-only CSV sink for experiment records.
+
+    Accepts dicts or RunResult-like objects (anything with ``as_df``); the
+    CSV header is taken from the first record (reference idiom: results
+    persisted to CSV for re-plotting, hw03 cells 11, 18, 29).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fieldnames: Optional[List[str]] = None
+        if os.path.exists(path):
+            with open(path, newline="") as f:
+                reader = csv.reader(f)
+                self._fieldnames = next(reader, None)
+
+    def write(self, record: Any) -> None:
+        if hasattr(record, "as_df"):
+            for row in record.as_df().to_dict(orient="records"):
+                self._write_row(row)
+        else:
+            self._write_row(dict(record))
+
+    def _write_row(self, row: Dict[str, Any]) -> None:
+        new_file = self._fieldnames is None
+        if new_file:
+            self._fieldnames = list(row.keys())
+        with open(self.path, "a", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=self._fieldnames,
+                                    extrasaction="ignore")
+            if new_file:
+                writer.writeheader()
+            writer.writerow(row)
+
+    def read_df(self):
+        import pandas as pd
+        return pd.read_csv(self.path)
