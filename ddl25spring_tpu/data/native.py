@@ -1,0 +1,159 @@
+"""ctypes bindings for the native (C++) token data pipeline.
+
+The native engine (native/tokenstream.cpp) supplies the framework's
+equivalent of the reference's native data path (sentencepiece C++ +
+dataloader machinery inside its deps — SURVEY.md §2.12): SP-compatible
+encoding, sequence packing with skip offsets, and a producer thread with a
+bounded prefetch ring so tokenization overlaps device compute.
+
+`NativeTokenStream` is a drop-in for data.tokens.TokenStream (same batch
+shapes, same skip semantics, same corpus-file behavior). If the shared
+library is missing it is built on first use with `make` (g++ is in the
+image); if that fails, callers should fall back to the pure-Python stream —
+`native_available()` reports which world you're in.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtokenstream.so"))
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                       check=True, capture_output=True, timeout=300)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build():
+        raise OSError("native tokenstream library unavailable "
+                      f"(build failed; see {_NATIVE_DIR})")
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.ts_create.restype = ctypes.c_void_p
+    lib.ts_create.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.ts_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.ts_encode.restype = ctypes.c_int64
+    lib.ts_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+    lib.ts_batches_produced.restype = ctypes.c_int64
+    lib.ts_batches_produced.argtypes = [ctypes.c_void_p]
+    lib.ts_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except OSError:
+        return False
+
+
+def _vocab_arrays(tokenizer) -> Tuple[bytes, np.ndarray, np.ndarray, np.ndarray, bool]:
+    """Flatten a tokenizers.spm.SentencePieceTokenizer's piece table into the
+    (pieces_blob, offsets, scores, types) arrays the C ABI takes."""
+    pieces: List[Tuple[str, float, int]] = tokenizer.pieces
+    blobs = [p.encode("utf-8") for p, _, _ in pieces]
+    offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return (b"".join(blobs), offsets,
+            np.asarray([s for _, s, _ in pieces], dtype=np.float32),
+            np.asarray([t for _, _, t in pieces], dtype=np.int32),
+            bool(tokenizer.is_bpe))
+
+
+class NativeTokenStream:
+    """Drop-in for data.tokens.TokenStream backed by the C++ engine.
+
+    Requires a SentencePieceTokenizer (it ships the piece table across the
+    ABI); for ByteTokenizer or other tokenizers use the Python stream.
+    """
+
+    def __init__(self, tokenizer, batch_size: int, seq_len: int, *,
+                 skip: int = 0, path: Optional[str] = None, seed: int = 0,
+                 prefetch: int = 4):
+        if not hasattr(tokenizer, "pieces"):
+            raise TypeError("NativeTokenStream needs a SentencePieceTokenizer "
+                            "(piece table); use data.tokens.TokenStream")
+        lib = _load()
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        blob, offsets, scores, types, is_bpe = _vocab_arrays(tokenizer)
+        # Resolve the corpus the same way the Python stream does.
+        from .tokens import _DEFAULT_CORPUS
+        corpus = b""
+        for c in (path, os.environ.get("DDL_TINYSTORIES"), *_DEFAULT_CORPUS):
+            if c and os.path.exists(c):
+                corpus = os.path.abspath(c).encode()
+                break
+        self._lib = lib
+        self._handle = lib.ts_create(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            scores.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(types), int(is_bpe), corpus, seed,
+            batch_size, seq_len, skip, prefetch)
+        # keep the arrays alive until ts_create returns (it copies them)
+        del blob, offsets, scores, types
+
+    def encode(self, text: str, *, add_bos: bool = False) -> List[int]:
+        """Direct native encode (parity-testable against spm.py)."""
+        data = text.encode("utf-8")
+        cap = max(4 * len(data) + 8, 64)
+        out = np.empty(cap, dtype=np.int32)
+        n = self._lib.ts_encode(
+            self._handle, data, len(data), int(add_bos),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        if n > cap:  # shouldn't happen with the generous cap; re-ask
+            out = np.empty(n, dtype=np.int32)
+            n = self._lib.ts_encode(
+                self._handle, data, len(data), int(add_bos),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+        return out[:n].tolist()
+
+    def next_batch(self) -> np.ndarray:
+        out = np.empty((self.batch_size, self.seq_len), dtype=np.int32)
+        self._lib.ts_next(
+            self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+
+    def batches_produced(self) -> int:
+        return int(self._lib.ts_batches_produced(self._handle))
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch()
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ts_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

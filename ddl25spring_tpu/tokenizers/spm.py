@@ -89,8 +89,20 @@ class SentencePieceTokenizer:
     def __init__(self, model_path: str):
         with open(model_path, "rb") as f:
             pieces, model_type = parse_model_proto(f.read())
+        self._setup(pieces, model_type == 2)
+
+    @classmethod
+    def from_pieces(cls, pieces: List[Tuple[str, float, int]], *,
+                    is_bpe: bool = False) -> "SentencePieceTokenizer":
+        """Build from an in-memory (piece, score, type) table — used by tests
+        and by the native-pipeline parity harness."""
+        self = cls.__new__(cls)
+        self._setup(pieces, is_bpe)
+        return self
+
+    def _setup(self, pieces: List[Tuple[str, float, int]], is_bpe: bool) -> None:
         self.pieces = pieces
-        self.is_bpe = model_type == 2
+        self.is_bpe = is_bpe
         self.vocab_size = len(pieces)
         self._piece_to_id: Dict[str, int] = {}
         self._byte_to_id: Dict[int, int] = {}

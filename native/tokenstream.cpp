@@ -1,0 +1,429 @@
+// Native host-side token data pipeline for ddl25spring_tpu.
+//
+// Role: the reference's data path leans on native code inside its
+// dependencies (sentencepiece C++ behind simplellm's SPTokenizer, libtorch
+// dataloader machinery — SURVEY.md §2.12). This is the framework's own
+// native equivalent: SentencePiece-compatible encoding (BPE greedy-merge and
+// unigram Viterbi, mirroring ddl25spring_tpu/tokenizers/spm.py semantics
+// including tie-breaking), document sourcing (corpus file or synthetic
+// TinyStories-style grammar), fixed-shape sequence packing with the
+// reference's skip-offset semantics (intro_DP_GA.py:29), and a threaded
+// prefetch ring so tokenization overlaps TPU compute.
+//
+// Exposed via a C ABI consumed by ctypes (ddl25spring_tpu/data/native.py).
+// Build: make -C native   (g++ -O2 -shared -fPIC, pthreads only).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kTypeNormal = 1, kTypeUnknown = 2, kTypeControl = 3,
+              kTypeByte = 6;
+
+// ----------------------------------------------------------------- vocab
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> piece_to_id;
+  std::unordered_map<uint8_t, int32_t> byte_to_id;
+  std::vector<float> scores;
+  int32_t unk_id = 0, bos_id = -1, eos_id = -1;
+  bool is_bpe = false;
+  int max_piece_cp = 1;  // longest NORMAL piece, in code points
+  float unk_penalty = -20.0f;
+};
+
+int codepoint_len(const std::string& s) {
+  int n = 0;
+  for (unsigned char c : s)
+    if ((c & 0xC0) != 0x80) n++;
+  return n;
+}
+
+Vocab* build_vocab(const uint8_t* pieces, const int64_t* offsets,
+                   const float* scores, const int32_t* types,
+                   int32_t n_pieces, int32_t is_bpe) {
+  auto* v = new Vocab();
+  v->is_bpe = is_bpe != 0;
+  v->scores.assign(scores, scores + n_pieces);
+  float min_score = 0.0f;
+  for (int32_t i = 0; i < n_pieces; i++) {
+    std::string piece(reinterpret_cast<const char*>(pieces + offsets[i]),
+                      offsets[i + 1] - offsets[i]);
+    int32_t t = types[i];
+    if (t == kTypeByte) {
+      // pieces look like "<0x0A>"
+      v->byte_to_id[(uint8_t)std::stoi(piece.substr(3, 2), nullptr, 16)] = i;
+    } else if (t == kTypeUnknown) {
+      v->unk_id = i;
+    } else if (t == kTypeControl) {
+      if (piece == "<s>") v->bos_id = i;
+      else if (piece == "</s>") v->eos_id = i;
+    } else {
+      v->piece_to_id.emplace(std::move(piece), i);
+    }
+    if (t == kTypeNormal) {
+      std::string p(reinterpret_cast<const char*>(pieces + offsets[i]),
+                    offsets[i + 1] - offsets[i]);
+      v->max_piece_cp = std::max(v->max_piece_cp, codepoint_len(p));
+    }
+    min_score = std::min(min_score, scores[i]);
+  }
+  v->unk_penalty = n_pieces ? min_score - 10.0f : -20.0f;
+  return v;
+}
+
+// ----------------------------------------------------------- encoding
+
+// Split a UTF-8 string into byte offsets of each code point (plus end).
+std::vector<int> cp_offsets(const std::string& s) {
+  std::vector<int> off;
+  for (int i = 0; i < (int)s.size(); i++)
+    if (((unsigned char)s[i] & 0xC0) != 0x80) off.push_back(i);
+  off.push_back((int)s.size());
+  return off;
+}
+
+void byte_fallback(const Vocab& v, const std::string& piece,
+                   std::vector<int32_t>* out) {
+  bool all = true;
+  for (unsigned char b : piece)
+    if (!v.byte_to_id.count(b)) { all = false; break; }
+  if (all)
+    for (unsigned char b : piece) out->push_back(v.byte_to_id.at(b));
+  else
+    out->push_back(v.unk_id);
+}
+
+// SentencePiece-BPE greedy merge, mirroring spm.py _encode_bpe exactly:
+// repeatedly merge the adjacent pair whose concatenation has the highest
+// score, ties broken by smallest left index (Python's (-score, i, j) heap).
+void encode_bpe(const Vocab& v, const std::string& s,
+                std::vector<int32_t>* out) {
+  auto off = cp_offsets(s);
+  int n = (int)off.size() - 1;
+  if (n == 0) return;
+  // parts are contiguous byte ranges [start, end) over s.
+  std::vector<int> pstart(n), pend(n), nxt(n), prv(n);
+  std::vector<char> alive(n, 1);
+  for (int i = 0; i < n; i++) {
+    pstart[i] = off[i];
+    pend[i] = off[i + 1];
+    nxt[i] = i + 1 < n ? i + 1 : -1;
+    prv[i] = i - 1;
+  }
+  struct Cand { float neg_score; int i, j; };
+  auto cmp = [](const Cand& a, const Cand& b) {
+    if (a.neg_score != b.neg_score) return a.neg_score > b.neg_score;
+    if (a.i != b.i) return a.i > b.i;
+    return a.j > b.j;  // min-heap on (neg_score, i, j), like Python's heapq
+  };
+  std::priority_queue<Cand, std::vector<Cand>, decltype(cmp)> heap(cmp);
+  auto push = [&](int i) {
+    int j = nxt[i];
+    if (j == -1) return;
+    auto it = v.piece_to_id.find(s.substr(pstart[i], pend[j] - pstart[i]));
+    if (it != v.piece_to_id.end())
+      heap.push({-v.scores[it->second], i, j});
+  };
+  for (int i = 0; i < n - 1; i++) push(i);
+  while (!heap.empty()) {
+    Cand c = heap.top();
+    heap.pop();
+    int i = c.i, j = c.j;
+    if (!alive[i] || !alive[j] || nxt[i] != j) continue;  // stale
+    pend[i] = pend[j];
+    alive[j] = 0;
+    nxt[i] = nxt[j];
+    if (nxt[j] != -1) prv[nxt[j]] = i;
+    if (prv[i] != -1) push(prv[i]);
+    push(i);
+  }
+  for (int i = 0; i != -1; i = nxt[i]) {
+    if (!alive[i]) continue;
+    std::string part = s.substr(pstart[i], pend[i] - pstart[i]);
+    auto it = v.piece_to_id.find(part);
+    if (it != v.piece_to_id.end()) out->push_back(it->second);
+    else byte_fallback(v, part, out);
+  }
+}
+
+// Unigram Viterbi, mirroring spm.py _encode_unigram (incl. the reversed
+// byte order quirk of its backtrack fallback).
+void encode_unigram(const Vocab& v, const std::string& s,
+                    std::vector<int32_t>* out) {
+  auto off = cp_offsets(s);
+  int n = (int)off.size() - 1;
+  constexpr double NEG = -1e18;
+  std::vector<double> best(n + 1, NEG);
+  std::vector<int> back_start(n + 1, -2);
+  std::vector<int32_t> back_id(n + 1, -1);
+  best[0] = 0.0;
+  for (int end = 1; end <= n; end++) {
+    int lo = std::max(0, end - v.max_piece_cp);
+    for (int start = lo; start < end; start++) {
+      if (best[start] <= NEG / 2) continue;
+      auto it = v.piece_to_id.find(
+          s.substr(off[start], off[end] - off[start]));
+      if (it == v.piece_to_id.end()) continue;
+      double sc = best[start] + v.scores[it->second];
+      if (sc > best[end]) {
+        best[end] = sc;
+        back_start[end] = start;
+        back_id[end] = it->second;
+      }
+    }
+    if (back_start[end] == -2 && best[end - 1] > NEG / 2) {
+      best[end] = best[end - 1] + v.unk_penalty;
+      back_start[end] = end - 1;
+      back_id[end] = -1;
+    }
+  }
+  std::vector<int32_t> rev;
+  int pos = n;
+  while (pos > 0) {
+    int start = back_start[pos];
+    int32_t pid = back_id[pos];
+    if (pid >= 0) {
+      rev.push_back(pid);
+    } else {
+      std::string ch = s.substr(off[start], off[pos] - off[start]);
+      bool all = true;
+      for (unsigned char b : ch)
+        if (!v.byte_to_id.count(b)) { all = false; break; }
+      if (all) {
+        // spm.py extends with reversed(bytes) while building the reversed
+        // list — net effect: bytes come out in forward order after the
+        // final reverse; match it.
+        for (auto it = ch.rbegin(); it != ch.rend(); ++it)
+          rev.push_back(v.byte_to_id.at((unsigned char)*it));
+      } else {
+        rev.push_back(v.unk_id);
+      }
+    }
+    pos = start;
+  }
+  out->insert(out->end(), rev.rbegin(), rev.rend());
+}
+
+const char kWS[] = "\xE2\x96\x81";  // "▁" U+2581
+
+void encode(const Vocab& v, const std::string& text, bool add_bos,
+            std::vector<int32_t>* out) {
+  std::string s = kWS;
+  for (char c : text) {
+    if (c == ' ') s += kWS;
+    else s += c;
+  }
+  if (add_bos && v.bos_id >= 0) out->push_back(v.bos_id);
+  if (v.is_bpe) encode_bpe(v, s, out);
+  else encode_unigram(v, s, out);
+}
+
+// ------------------------------------------------------ document sources
+
+const char* kNames[] = {"Lily", "Tom", "Mia", "Ben", "Sara", "Max", "Anna",
+                        "Leo", "Ella", "Sam", "Lucy", "Tim", "Amy", "Jack",
+                        "Rosa", "Finn"};
+const char* kAnimals[] = {"cat", "dog", "bird", "bunny", "frog", "duck",
+                          "fox", "bear", "mouse", "owl"};
+const char* kObjects[] = {"ball", "kite", "book", "toy", "hat", "cake",
+                          "flower", "boat", "drum", "star"};
+const char* kPlaces[] = {"park", "garden", "forest", "house", "beach",
+                         "hill", "farm", "pond", "yard", "school"};
+const char* kAdjs[] = {"happy", "little", "big", "red", "shiny", "soft",
+                       "brave", "silly", "kind", "tiny"};
+const char* kVerbs[] = {"played", "jumped", "ran", "laughed", "sang",
+                        "danced", "walked", "smiled", "looked", "hopped"};
+
+struct DocSource {
+  std::vector<std::string> corpus;  // empty -> synthetic
+  size_t next_line = 0;
+  std::mt19937_64 rng;
+
+  explicit DocSource(const char* path, uint64_t seed) : rng(seed) {
+    if (path && *path) {
+      std::ifstream f(path);
+      std::string line;
+      while (std::getline(f, line)) {
+        while (!line.empty() && (line.back() == '\r' || line.back() == '\n' ||
+                                 line.back() == ' '))
+          line.pop_back();
+        if (!line.empty()) corpus.push_back(line);
+      }
+    }
+  }
+
+  template <size_t N>
+  const char* pick(const char* (&arr)[N]) {
+    return arr[rng() % N];
+  }
+
+  std::string synthetic() {
+    // Same grammar as data/tokens.py synthetic_story (its numpy RNG stream
+    // differs — native runs are self-consistent, not cross-runtime
+    // reproducible with the Python generator).
+    std::string name = pick(kNames), name2 = pick(kNames);
+    std::string animal = pick(kAnimals), animal2 = pick(kAnimals);
+    std::string obj = pick(kObjects), place = pick(kPlaces);
+    std::string adj = pick(kAdjs), adj2 = pick(kAdjs);
+    std::string verb = pick(kVerbs), verb2 = pick(kVerbs);
+    switch (rng() % 4) {
+      case 0:
+        return "Once upon a time there was a " + adj + " " + animal +
+               " named " + name + ". " + name + " loved to play with a " +
+               obj + " in the " + place + ". One day " + name + " " + verb +
+               " all day long. The " + animal + " was very " + adj2 +
+               ". At the end of the day " + name + " went home and slept.";
+      case 1:
+        return name + " and " + name2 + " went to the " + place +
+               ". They found a " + adj + " " + obj + ". " + name +
+               " said, I want to share this " + obj + " with you. " + name2 +
+               " " + verb + " with joy. They were " + adj2 +
+               " friends forever.";
+      case 2:
+        return "One day a " + adj + " " + animal + " found a " + obj +
+               " near the " + place + ". The " + animal + " " + verb +
+               " and " + verb2 + ". A " + adj2 + " " + animal2 +
+               " came to help. Together they played until the sun went down.";
+      default:
+        return "Little " + name + " had a " + adj + " " + obj +
+               ". Every morning " + name + " took the " + obj + " to the " +
+               place + ". One day the " + obj + " was lost. " + name + " " +
+               verb + " everywhere. A " + adj2 + " " + animal +
+               " found it and " + name + " was happy again.";
+    }
+  }
+
+  std::string next() {
+    if (corpus.empty()) return synthetic();
+    std::string d = corpus[next_line];
+    next_line = (next_line + 1) % corpus.size();
+    return d;
+  }
+};
+
+// ------------------------------------------------------ prefetch pipeline
+
+struct TokenStream {
+  Vocab* vocab;
+  DocSource docs;
+  int32_t batch, seq_len, prefetch;
+  int64_t skip;
+  std::vector<int32_t> buf;       // token accumulator
+  std::deque<std::vector<int32_t>> ready;  // each [batch*seq_len]
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::thread producer;
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> produced{0};
+  bool started = false;
+
+  TokenStream(Vocab* v, const char* path, uint64_t seed, int32_t batch_,
+              int32_t seq_len_, int64_t skip_, int32_t prefetch_)
+      : vocab(v), docs(path, seed), batch(batch_), seq_len(seq_len_),
+        prefetch(std::max(1, prefetch_)), skip(skip_) {}
+
+  ~TokenStream() {
+    stop.store(true);
+    cv_space.notify_all();
+    if (producer.joinable()) producer.join();
+    delete vocab;
+  }
+
+  void fill_seq(int32_t* out) {
+    while ((int64_t)buf.size() < seq_len) {
+      std::vector<int32_t> ids;
+      encode(*vocab, docs.next(), /*add_bos=*/true, &ids);
+      if (vocab->eos_id >= 0) ids.push_back(vocab->eos_id);
+      buf.insert(buf.end(), ids.begin(), ids.end());
+    }
+    std::copy(buf.begin(), buf.begin() + seq_len, out);
+    buf.erase(buf.begin(), buf.begin() + seq_len);
+  }
+
+  void run() {
+    std::vector<int32_t> tmp(seq_len);
+    for (int64_t i = 0; i < skip && !stop.load(); i++) fill_seq(tmp.data());
+    while (!stop.load()) {
+      std::vector<int32_t> out((size_t)batch * seq_len);
+      for (int32_t b = 0; b < batch; b++) fill_seq(out.data() + (size_t)b * seq_len);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] {
+        return stop.load() || (int32_t)ready.size() < prefetch;
+      });
+      if (stop.load()) return;
+      ready.push_back(std::move(out));
+      produced.fetch_add(1);
+      cv_ready.notify_one();
+    }
+  }
+
+  void next(int32_t* out) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (!started) {
+        started = true;
+        producer = std::thread([this] { run(); });
+      }
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    cv_ready.wait(lk, [&] { return !ready.empty(); });
+    std::vector<int32_t> b = std::move(ready.front());
+    ready.pop_front();
+    cv_space.notify_one();
+    lk.unlock();
+    std::memcpy(out, b.data(), b.size() * sizeof(int32_t));
+  }
+};
+
+}  // namespace
+
+// ----------------------------------------------------------------- C ABI
+
+extern "C" {
+
+void* ts_create(const uint8_t* pieces, const int64_t* offsets,
+                const float* scores, const int32_t* types, int32_t n_pieces,
+                int32_t is_bpe, const char* corpus_path, uint64_t seed,
+                int32_t batch, int32_t seq_len, int64_t skip,
+                int32_t prefetch) {
+  Vocab* v = build_vocab(pieces, offsets, scores, types, n_pieces, is_bpe);
+  return new TokenStream(v, corpus_path, seed, batch, seq_len, skip, prefetch);
+}
+
+void ts_next(void* h, int32_t* out) {
+  static_cast<TokenStream*>(h)->next(out);
+}
+
+// Encode `text` (UTF-8) directly; returns the id count (caller provides
+// capacity; overflow returns the required size without writing past cap).
+int64_t ts_encode(void* h, const char* text, int64_t text_len,
+                  int32_t add_bos, int32_t* out, int64_t cap) {
+  auto* ts = static_cast<TokenStream*>(h);
+  std::vector<int32_t> ids;
+  encode(*ts->vocab, std::string(text, (size_t)text_len), add_bos != 0, &ids);
+  int64_t n = (int64_t)ids.size();
+  if (n <= cap) std::memcpy(out, ids.data(), n * sizeof(int32_t));
+  return n;
+}
+
+int64_t ts_batches_produced(void* h) {
+  return static_cast<TokenStream*>(h)->produced.load();
+}
+
+void ts_destroy(void* h) { delete static_cast<TokenStream*>(h); }
+
+}  // extern "C"
