@@ -1,20 +1,30 @@
-"""Fused causal attention (FlashAttention) as a Pallas TPU kernel.
+"""Fused causal attention (FlashAttention) as Pallas TPU kernels, fwd + bwd.
 
 Capability/perf target: the reference computes attention inside simplellm's
 torch modules (materializing the full [T, T] score matrix per head). On TPU
-the memory-bound step is HBM traffic for those scores; this kernel streams
+the memory-bound step is HBM traffic for those scores; these kernels stream
 K/V blocks through VMEM with the online-softmax recurrence so scores never
-leave the chip, and the matmuls hit the MXU in bf16.
+leave the chip, and the matmuls hit the MXU.
+
+The op is differentiable via ``jax.custom_vjp``: the forward kernel saves the
+per-row logsumexp (LSE) alongside the output, and the backward pass recomputes
+attention probabilities block-wise from (q, k, lse) — the standard
+FlashAttention backward — in two kernels:
+
+- dQ kernel: for each query block, sweep key blocks (sequential last grid
+  axis), accumulating ``dq += ds @ k`` in VMEM scratch;
+- dK/dV kernel: for each key block, sweep query blocks, accumulating
+  ``dk += ds^T @ q`` and ``dv += p^T @ do``.
 
 Design notes (see /opt/skills/guides/pallas_guide.md):
-- grid = (batch·heads, q_blocks, k_blocks); the LAST grid axis runs
-  sequentially on TPU, so the (m, l, acc) running statistics live in VMEM
-  scratch that persists across the k sweep for a fixed q block.
-- m/l scratch is shaped (block_q, 128) — lane-width replicated — to respect
-  the fp32 (8, 128) min tile; column values are identical across lanes.
+- grid = (batch*heads, outer_blocks, inner_blocks); the LAST grid axis runs
+  sequentially on TPU, so running statistics / accumulators live in VMEM
+  scratch that persists across the inner sweep.
+- m/l/lse/delta are kept lane-replicated at (block, 128) to respect the fp32
+  (8, 128) min tile; column values are identical across lanes.
 - Causal blocks strictly above the diagonal are skipped via `pl.when`
-  (predicated out — no FLOPs, no VMEM traffic); the diagonal block applies
-  an iota mask.
+  (predicated out — no FLOPs), and their block index maps are clamped so the
+  pipeline elides the HBM fetch entirely.
 - On non-TPU backends `interpret=True` keeps tests runnable on the virtual
   CPU mesh; production CPU paths should use the XLA einsum attention
   (models/llama._xla_attention) instead.
@@ -34,9 +44,11 @@ _LANES = 128
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  block_q: int, block_k: int, n_k_blocks: int, scale: float,
-                  causal: bool, seq_len: int):
+# ------------------------------------------------------------------ forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
+                block_q: int, block_k: int, n_k_blocks: int, scale: float,
+                causal: bool, seq_len: int):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -86,65 +98,51 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:, :1]
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.broadcast_to(safe, lse_ref.shape[1:]))
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
-def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                    causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None
-                    ) -> jnp.ndarray:
-    """Fused attention. q, k, v: [B, T, H, Dh] (same layout as the XLA path
-    in models/llama.attention). Returns [B, T, H, Dh].
+def _causal_kv_index(block_q: int, block_k: int):
+    # Above-diagonal grid steps are predicated out in the kernel; clamp
+    # their K/V block index to the diagonal so consecutive steps reference
+    # the same block and the pipeline elides the HBM fetch entirely.
+    def kv_index(bh, iq, ik):
+        return (bh, jnp.minimum(ik, (iq * block_q + block_q - 1) // block_k), 0)
+    return kv_index
 
-    Sequence length is padded up to a block multiple internally; with
-    ``causal=True`` the tail padding keys are masked by causality for every
-    real query, so no extra length mask is needed.
+
+def _fwd(qb, kb, vb, causal: bool, block_q: int, block_k: int,
+         interpret: bool, seq_len: int, out_dtype):
+    """Runs the forward kernel on [BH, T_pad, Dh] inputs.
+
+    Returns (out [BH, T_pad, Dh], lse [BH, T_pad, LANES] lane-replicated).
     """
-    b, t, h, dh = q.shape
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    # Sequence is padded to a common multiple of both block sizes so the
-    # q and k grids each tile t_pad exactly; padded keys are masked in the
-    # kernel and padded query rows are trimmed on return.
-    lcm = math.lcm(block_q, block_k)
-    t_pad = math.ceil(t / lcm) * lcm
-
-    def to_bh(x):
-        x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, dh)      # [BH, T, Dh]
-        if t_pad != t:
-            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
-        return x
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    bh, t_pad, dh = qb.shape
     n_q = t_pad // block_q
     n_k = t_pad // block_k
     scale = 1.0 / math.sqrt(dh)
 
     kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, n_k_blocks=n_k,
-        scale=scale, causal=causal, seq_len=t)
+        _fwd_kernel, block_q=block_q, block_k=block_k, n_k_blocks=n_k,
+        scale=scale, causal=causal, seq_len=seq_len)
+    kv_index = (_causal_kv_index(block_q, block_k) if causal
+                else (lambda bh_, iq, ik: (bh_, ik, 0)))
 
-    if causal:
-        # Above-diagonal grid steps are predicated out in the kernel; clamp
-        # their K/V block index to the diagonal so consecutive steps reference
-        # the same block and the pipeline elides the HBM fetch entirely.
-        def kv_index(bh, iq, ik):
-            return (bh, jnp.minimum(ik, (iq * block_q + block_q - 1) // block_k), 0)
-    else:
-        def kv_index(bh, iq, ik):
-            return (bh, ik, 0)
-
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        grid=(b * h, n_q, n_k),
+        grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, dh), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda bh_, iq, ik: (bh_, iq, 0)),
             pl.BlockSpec((1, block_k, dh), kv_index),
             pl.BlockSpec((1, block_k, dh), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dh), lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, dh), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh_, iq, ik: (bh_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, dh), out_dtype),
+            jax.ShapeDtypeStruct((bh, t_pad, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),       # m
             pltpu.VMEM((block_q, _LANES), jnp.float32),       # l
@@ -153,5 +151,234 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         interpret=interpret,
     )(qb, kb, vb)
 
-    out = out[:, :t].reshape(b, h, t, dh)
-    return jnp.moveaxis(out, 1, 2)                            # [B, T, H, Dh]
+
+# ----------------------------------------------------------------- backward
+
+def _bwd_mask(iq, ik, block_q: int, block_k: int, causal: bool, seq_len: int):
+    """[bq, bk] validity mask. Unlike the forward (where padded query rows
+    are merely trimmed), the backward MUST zero padded query rows: their
+    lse is -inf, so exp(s - lse) would overflow and 0*inf-poison dK/dV."""
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+        + iq * block_q
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
+        + ik * block_k
+    mask = qpos < seq_len
+    if causal:
+        mask &= qpos >= kpos
+    else:
+        mask &= kpos < seq_len
+    return mask
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, *, block_q, block_k, scale,
+              causal, seq_len):
+    """Shared recompute: attention probs p and score-gradient ds for a block.
+
+    p  = exp(q k^T scale - lse)         (exact softmax probabilities)
+    ds = p * (do v^T - delta) * scale   (delta = rowsum(do * o))
+    """
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _bwd_mask(iq, ik, block_q, block_k, causal, seq_len)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)               # [bq, bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale                            # [bq, bk]
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, block_q: int, block_k: int, n_k_blocks: int,
+               scale: float, causal: bool, seq_len: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_p_ds(q, k, v, do, lse_ref[0][:, :1], delta_ref[0][:, :1],
+                          iq, ik, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal, seq_len=seq_len)
+        dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, block_q: int, block_k: int,
+                n_q_blocks: int, scale: float, causal: bool, seq_len: int):
+    # Grid is (bh, ik, iq): the sequential inner sweep is over QUERY blocks.
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0][:, :1], delta_ref[0][:, :1],
+                          iq, ik, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal, seq_len=seq_len)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_kernels(qb, kb, vb, dob, lse, delta, causal: bool, block_q: int,
+                 block_k: int, interpret: bool, seq_len: int):
+    """Runs dQ and dK/dV kernels on [BH, T_pad, Dh] inputs."""
+    bh, t_pad, dh = qb.shape
+    n_q = t_pad // block_q
+    n_k = t_pad // block_k
+    scale = 1.0 / math.sqrt(dh)
+    common = dict(block_q=block_q, block_k=block_k, scale=scale,
+                  causal=causal, seq_len=seq_len)
+
+    q_spec = pl.BlockSpec((1, block_q, dh), lambda bh_, iq, ik: (bh_, iq, 0))
+    row_spec = pl.BlockSpec((1, block_q, _LANES),
+                            lambda bh_, iq, ik: (bh_, iq, 0))
+    kv_index = (_causal_kv_index(block_q, block_k) if causal
+                else (lambda bh_, iq, ik: (bh_, ik, 0)))
+    kv_spec = pl.BlockSpec((1, block_k, dh), kv_index)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, n_k_blocks=n_k, **common),
+        grid=(bh, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, dh), qb.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    # Grid reordered to (bh, ik, iq). Below-diagonal skipped steps clamp the
+    # q-side index to the first contributing q block of this key block.
+    if causal:
+        def q_index(bh_, ik, iq):
+            return (bh_, jnp.maximum(iq, (ik * block_k) // block_q), 0)
+    else:
+        def q_index(bh_, ik, iq):
+            return (bh_, iq, 0)
+    q_spec_t = pl.BlockSpec((1, block_q, dh), q_index)
+    row_spec_t = pl.BlockSpec((1, block_q, _LANES), q_index)
+    kv_spec_t = pl.BlockSpec((1, block_k, dh),
+                             lambda bh_, ik, iq: (bh_, ik, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, n_q_blocks=n_q, **common),
+        grid=(bh, n_k, n_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct((bh, t_pad, dh), kb.dtype),
+                   jax.ShapeDtypeStruct((bh, t_pad, dh), vb.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, dh), jnp.float32),
+                        pltpu.VMEM((block_k, dh), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------- custom_vjp + public API
+
+def _layout(x, t_pad: int):
+    """[B, T, H, Dh] -> [B*H, T_pad, Dh] (the kernels' layout)."""
+    b, t, h, dh = x.shape
+    x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, dh)
+    if t_pad != t:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    return x
+
+
+def _unlayout(x, b: int, t: int):
+    """[B*H, T_pad, Dh] -> [B, T, H, Dh]."""
+    bh, _, dh = x.shape
+    return jnp.moveaxis(x[:, :t].reshape(b, bh // b, t, dh), 1, 2)
+
+
+def _pad_len(t: int, block_q: int, block_k: int) -> int:
+    # Common multiple of both block sizes so the q and k grids each tile
+    # t_pad exactly.
+    lcm = math.lcm(block_q, block_k)
+    return math.ceil(t / lcm) * lcm
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal: bool, block_q: int, block_k: int,
+           interpret: bool):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, dh = q.shape
+    t_pad = _pad_len(t, block_q, block_k)
+    out, lse = _fwd(_layout(q, t_pad), _layout(k, t_pad), _layout(v, t_pad),
+                    causal, block_q, block_k, interpret, t, q.dtype)
+    out = _unlayout(out, b, t)
+    # The kernel emits lse lane-replicated ([BH, T_pad, 128]); keep only one
+    # lane as the residual (128x less memory held until the backward).
+    return out, (q, k, v, out, lse[:, :, :1])
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, dh = q.shape
+    t_pad = _pad_len(t, block_q, block_k)
+    lse = jnp.broadcast_to(lse, (b * h, t_pad, _LANES))
+    # delta = rowsum(dO * O), the softmax-Jacobian correction term. An XLA
+    # elementwise reduce — not worth a kernel. Lane-replicated like lse.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.moveaxis(delta, 2, 1).reshape(b * h, t)       # [BH, T]
+    delta = jnp.pad(delta, ((0, 0), (0, t_pad - t)))
+    delta = jnp.broadcast_to(delta[:, :, None], (b * h, t_pad, _LANES))
+    dq, dk, dv = _bwd_kernels(
+        _layout(q, t_pad), _layout(k, t_pad), _layout(v, t_pad),
+        _layout(g, t_pad), lse, delta, causal, block_q, block_k, interpret, t)
+    return (_unlayout(dq, b, t), _unlayout(dk, b, t), _unlayout(dv, b, t))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None
+                    ) -> jnp.ndarray:
+    """Fused attention, differentiable. q, k, v: [B, T, H, Dh] (same layout
+    as the XLA path in models/llama.attention). Returns [B, T, H, Dh].
+
+    Sequence length is padded up to a block multiple internally; padded keys
+    get zero softmax mass and padded query rows are trimmed on return (and
+    zeroed in the backward).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

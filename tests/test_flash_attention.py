@@ -96,3 +96,128 @@ def test_llama_forward_with_pallas_attention():
     ref = llama.forward(params, tokens, cfg_ref)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=5e-2, rtol=5e-2)
+
+
+# ------------------------------------------------------------ backward pass
+
+def _loss_pair(t, dh, causal, dtype=jnp.float32, seed=7):
+    kq, kk, kv, kw = jax.random.split(jax.random.key(seed), 4)
+    b, h = 2, 2
+    q = jax.random.normal(kq, (b, t, h, dh), dtype)
+    k = jax.random.normal(kk, (b, t, h, dh), dtype)
+    v = jax.random.normal(kv, (b, t, h, dh), dtype)
+    w = jax.random.normal(kw, (b, t, h, dh), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    def loss_ref(q, k, v):
+        o = _ref_attention(q, k, v, causal=causal)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    return (q, k, v), loss_flash, loss_ref
+
+
+@pytest.mark.parametrize("t,causal", [(128, True), (128, False),
+                                      (100, True), (100, False)])
+def test_flash_grad_matches_xla(t, causal):
+    """dQ/dK/dV from the Pallas backward vs autodiff through the XLA path,
+    including non-block-multiple t (padded query rows must backprop zeros)."""
+    (q, k, v), loss_flash, loss_ref = _loss_pair(t, 32, causal)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4, err_msg=f"d{name}")
+
+
+def test_flash_grad_mismatched_blocks():
+    kq, kk, kv = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(kq, (1, 100, 2, 32), jnp.float32)
+    k = jax.random.normal(kk, (1, 100, 2, 32), jnp.float32)
+    v = jax.random.normal(kv, (1, 100, 2, 32), jnp.float32)
+
+    def f(impl):
+        def loss(q, k, v):
+            if impl == "pallas":
+                o = flash_attention(q, k, v, causal=True, block_q=128,
+                                    block_k=64)
+            else:
+                o = _ref_attention(q, k, v, causal=True)
+            return jnp.sum(o ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for gf, gr in zip(f("pallas"), f("xla")):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_train_step_with_pallas_attention():
+    """A full value_and_grad train step through the model with
+    attention_impl='pallas' (the path round-1 shipped broken)."""
+    import optax
+    from ddl25spring_tpu.ops import causal_lm_loss
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=64, num_heads=2, n_layers=2,
+                      ctx_size=64, attention_impl="pallas")
+    params = llama.init_llama(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, 128)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            return causal_lm_loss(llama.forward(p, tokens, cfg), tokens)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    params2, opt_state, loss = step(params, opt_state, tokens)
+    assert jnp.isfinite(loss)
+    # Params actually moved, and a second step also runs.
+    moved = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()) > 0,
+                         params, params2)
+    assert any(jax.tree.leaves(moved))
+    _, _, loss2 = step(params2, opt_state, tokens)
+    assert jnp.isfinite(loss2)
+
+
+def test_flash_on_real_tpu_smoke():
+    """Compile-and-numerics smoke on the real chip (Mosaic, not interpret).
+
+    The suite process is pinned to the virtual CPU mesh (conftest), so the
+    TPU run happens in a subprocess with the container's default platform.
+    Skips cleanly on hosts without a TPU. This is the guard that was missing
+    in round 1, when the suite stayed green while the kernel had no VJP.
+    """
+    import os
+    import subprocess
+    import sys
+
+    script = (
+        "import jax, jax.numpy as jnp\n"
+        "import sys\n"
+        "if jax.default_backend() != 'tpu': sys.exit(42)\n"
+        "from ddl25spring_tpu.ops.flash_attention import flash_attention\n"
+        "from ddl25spring_tpu.models import llama\n"
+        "ks = jax.random.split(jax.random.key(0), 4)\n"
+        "qkv = [jax.random.normal(k, (1, 256, 2, 48)) for k in ks[:3]]\n"
+        "w = jax.random.normal(ks[3], (1, 256, 2, 48))\n"
+        "out = flash_attention(*qkv, causal=True)\n"
+        "ref = llama._xla_attention(*qkv, causal=True)\n"
+        "assert float(jnp.abs(out - ref).max()) < 5e-2\n"
+        "gf = jax.grad(lambda q, k, v: jnp.sum(\n"
+        "    flash_attention(q, k, v, causal=True) * w), (0, 1, 2))(*qkv)\n"
+        "gr = jax.grad(lambda q, k, v: jnp.sum(\n"
+        "    llama._xla_attention(q, k, v, causal=True) * w), (0, 1, 2))(*qkv)\n"
+        "for a, b in zip(gf, gr):\n"
+        "    assert float(jnp.abs(a - b).max()) < 5e-2\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=540)
+    if proc.returncode == 42:
+        pytest.skip("no TPU on this host")
+    assert proc.returncode == 0, proc.stderr[-2000:]
