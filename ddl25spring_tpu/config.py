@@ -122,7 +122,10 @@ class VFLConfig:
     epochs: int = 300
     batch_size: int = 64
     lr: float = 1e-3
-    bottom_out_dim: int = 2        # per-client bottom model output width
+    # Per-client bottom output width multiplier: party i sends
+    # bottom_out_mult · d_i activations up the cut — the reference's
+    # outs_per_client sizing (vfl.py:139-141).
+    bottom_out_mult: int = 2
     seed: int = 0
 
 

@@ -19,25 +19,31 @@ import jax.numpy as jnp
 from jax import lax
 
 PyTree = Any
-# apply_fn(params, x) -> logits
-ApplyFn = Callable[[PyTree, jnp.ndarray], jnp.ndarray]
+# apply_fn(params, x, *, key=None) -> logits; dropout active iff key given
+# (the functional analog of the reference's model.train()/model.eval(),
+# hfl_complete.py:72,172).
+ApplyFn = Callable[..., jnp.ndarray]
 
 
 def masked_mean_loss(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray,
-                     y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+                     y: jnp.ndarray, mask: jnp.ndarray,
+                     key=None) -> jnp.ndarray:
     """Cross-entropy averaged over real (unmasked) samples — identical to
     torch's mean CE over a batch when mask is all-ones."""
-    logits = apply_fn(params, x)
+    logits = apply_fn(params, x) if key is None else apply_fn(params, x, key=key)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
 def full_batch_grad(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray,
-                    y: jnp.ndarray, mask: jnp.ndarray) -> Tuple[jnp.ndarray, PyTree]:
+                    y: jnp.ndarray, mask: jnp.ndarray,
+                    key=None) -> Tuple[jnp.ndarray, PyTree]:
     """One gradient over the client's whole subset — FedSGD's client step
-    (GradientClient.update, hfl_complete.py:241-253). Returns (loss, grads)."""
-    return jax.value_and_grad(partial(masked_mean_loss, apply_fn))(params, x, y, mask)
+    (GradientClient.update, hfl_complete.py:241-253; trains in train mode,
+    :271, so dropout is live when a key is threaded). Returns (loss, grads)."""
+    return jax.value_and_grad(partial(masked_mean_loss, apply_fn))(
+        params, x, y, mask, key)
 
 
 def _batched(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, batch_size: int):
@@ -57,23 +63,30 @@ def _batched(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, batch_size: int)
 
 
 def local_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray, y: jnp.ndarray,
-              mask: jnp.ndarray, *, epochs: int, batch_size: int, lr: float) -> PyTree:
+              mask: jnp.ndarray, *, epochs: int, batch_size: int, lr: float,
+              key=None) -> PyTree:
     """E epochs of plain SGD over fixed-order minibatches — WeightClient's
-    local loop (train_epoch, hfl_complete.py:71-80). Pure: returns the new
-    params; scan over (epochs × batches) keeps one compiled body."""
+    local loop (train_epoch, hfl_complete.py:71-80; model.train() ⇒ dropout
+    live per batch when a key is threaded). Pure: returns the new params;
+    scan over (epochs × batches) keeps one compiled body. Each (epoch, batch)
+    step folds its own dropout key from the client key."""
     xb, yb, mb = _batched(x, y, mask, batch_size)
+    n_batches = yb.shape[0]
 
-    def batch_step(p, batch):
+    def batch_step(carry, batch):
+        p, step_idx = carry
         bx, by, bm = batch
-        grads = jax.grad(partial(masked_mean_loss, apply_fn))(p, bx, by, bm)
+        bkey = None if key is None else jax.random.fold_in(key, step_idx)
+        grads = jax.grad(partial(masked_mean_loss, apply_fn))(p, bx, by, bm, bkey)
         # Empty (all-padding) batches contribute zero gradient.
         nonempty = (bm.sum() > 0).astype(jnp.float32)
         p = jax.tree.map(lambda w, g: w - lr * nonempty * g, p, grads)
-        return p, None
+        return (p, step_idx + 1), None
 
-    def epoch_step(p, _):
-        p, _ = lax.scan(batch_step, p, (xb, yb, mb))
-        return p, None
+    def epoch_step(carry, _):
+        carry, _ = lax.scan(batch_step, carry, (xb, yb, mb))
+        return carry, None
 
-    params, _ = lax.scan(epoch_step, params, None, length=epochs)
+    (params, _), _ = lax.scan(epoch_step, (params, jnp.zeros((), jnp.int32)),
+                              None, length=epochs)
     return params

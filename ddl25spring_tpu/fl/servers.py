@@ -97,7 +97,12 @@ class _ServerBase:
             wall, message_count(round_idx, self.cfg.clients_per_round), self.test())
 
     def _round(self, params, r):
-        return self._round_step(params, jnp.asarray(self._sample(r)))
+        idx = self._sample(r)
+        # Per-(client, round) PRNG keys from the reference seed formula:
+        # dropout inside local training (the reference trains in train mode,
+        # hfl_complete.py:72,271,351) and any data poisoning fold from these.
+        keys = jax.vmap(jax.random.key)(jnp.asarray(self.client_seeds(r, idx)))
+        return self._round_step(params, jnp.asarray(idx), keys)
 
     def run(self, nr_rounds: Optional[int] = None) -> RunResult:
         nr_rounds = self.cfg.rounds if nr_rounds is None else nr_rounds
@@ -118,10 +123,10 @@ class FedSgdGradientServer(_ServerBase):
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
 
         @jax.jit
-        def round_step(params, idx):
+        def round_step(params, idx, keys):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
-            _, grads = jax.vmap(lambda x, y, m: full_batch_grad(apply_fn, params, x, y, m)
-                                )(xs, ys, ms)
+            _, grads = jax.vmap(lambda x, y, m, k: full_batch_grad(
+                apply_fn, params, x, y, m, k))(xs, ys, ms, keys)
             w = _weights_for(data.sample_counts[idx])
             agg = pt.tree_weighted_sum(grads, w)
             return jax.tree.map(lambda p, g: p - cfg.lr * g, params, agg)
@@ -138,14 +143,14 @@ class FedSgdWeightServer(_ServerBase):
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
 
         @jax.jit
-        def round_step(params, idx):
+        def round_step(params, idx, keys):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
 
-            def client(x, y, m):
-                _, g = full_batch_grad(apply_fn, params, x, y, m)
+            def client(x, y, m, k):
+                _, g = full_batch_grad(apply_fn, params, x, y, m, k)
                 return jax.tree.map(lambda p, gi: p - cfg.lr * gi, params, g)
 
-            new_weights = jax.vmap(client)(xs, ys, ms)
+            new_weights = jax.vmap(client)(xs, ys, ms, keys)
             w = _weights_for(data.sample_counts[idx])
             return pt.tree_weighted_sum(new_weights, w)
 
@@ -161,12 +166,12 @@ class FedAvgServer(_ServerBase):
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
 
         @jax.jit
-        def round_step(params, idx):
+        def round_step(params, idx, keys):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
             new_weights = jax.vmap(
-                lambda x, y, m: local_sgd(apply_fn, params, x, y, m,
-                                          epochs=cfg.epochs, batch_size=cfg.batch_size,
-                                          lr=cfg.lr))(xs, ys, ms)
+                lambda x, y, m, k: local_sgd(apply_fn, params, x, y, m,
+                                             epochs=cfg.epochs, batch_size=cfg.batch_size,
+                                             lr=cfg.lr, key=k))(xs, ys, ms, keys)
             w = _weights_for(data.sample_counts[idx])
             return pt.tree_weighted_sum(new_weights, w)
 
@@ -198,12 +203,17 @@ class FedAvgGradServer(_ServerBase):
             def client(x, y, m, key, is_mal):
                 if attack is not None and attack.poisons_data:
                     # Data poisoning: malicious clients train on transformed
-                    # batches (label flips, backdoor stamps).
-                    px, py = attack.poison(x, y, key)
+                    # batches (label flips, backdoor stamps). The poison fold
+                    # constant is outside local_sgd's small step-index fold
+                    # domain so the streams stay independent, while local_sgd
+                    # still receives the raw client key — keeping honest
+                    # trajectories bit-identical to FedAvgServer's (the
+                    # delta-framing equivalence).
+                    px, py = attack.poison(x, y, jax.random.fold_in(key, 0x7EA))
                     x = jnp.where(is_mal, px, x)
                     y = jnp.where(is_mal, py, y)
                 new = local_sgd(apply_fn, params, x, y, m, epochs=cfg.epochs,
-                                batch_size=cfg.batch_size, lr=cfg.lr)
+                                batch_size=cfg.batch_size, lr=cfg.lr, key=key)
                 delta = pt.tree_sub(params, new)           # Δ = w0 − w_final
                 if attack is not None:
                     mal_delta = attack.transform(delta, params)
@@ -222,11 +232,6 @@ class FedAvgGradServer(_ServerBase):
             return pt.tree_sub(params, agg)
 
         self._round_step = round_step
-
-    def _round(self, params, r):
-        idx = self._sample(r)
-        keys = jax.vmap(jax.random.key)(jnp.asarray(self.client_seeds(r, idx)))
-        return self._round_step(params, jnp.asarray(idx), keys)
 
 
 class CentralizedServer(_ServerBase):
@@ -254,7 +259,8 @@ class CentralizedServer(_ServerBase):
                 jax.random.fold_in(jax.random.key(cfg.seed), r), data.y.shape[1])
             return local_sgd(apply_fn, params, data.x[0][perm], data.y[0][perm],
                              data.mask[0][perm], epochs=1,
-                             batch_size=cfg.batch_size, lr=cfg.lr)
+                             batch_size=cfg.batch_size, lr=cfg.lr,
+                             key=jax.random.fold_in(jax.random.key(cfg.seed + 1), r))
 
         self._round_step = round_step
 

@@ -2,9 +2,17 @@
 
 Capability target: the reference's `MnistCnn` (lab/tutorial_1a/
 hfl_complete.py:39-64), the model every FedSGD/FedAvg/attack/defense
-experiment trains. Standard two-conv CNN; inputs are NCHW [B, 1, 28, 28]
-normalized with the MNIST constants (0.1307, 0.3081) preserved by the data
-layer (hfl_complete.py:23).
+experiment trains, reproduced architecture-for-architecture:
+conv1(1→32,3) → relu → conv2(32→64,3) → relu → maxpool(2) → dropout(0.25)
+→ flatten (64·12·12 = 9216) → fc1(9216→128) → relu → dropout(0.5)
+→ fc2(128→10). Inputs are NCHW [B, 1, 28, 28] normalized with the MNIST
+constants (0.1307, 0.3081) preserved by the data layer (hfl_complete.py:23).
+
+The reference returns log-probabilities and trains with NLL loss; we return
+logits and train with cross-entropy — the same function. Dropout is active
+iff a PRNG ``key`` is passed (the functional analog of ``model.train()`` /
+``model.eval()``, hfl_complete.py:72,172): FL local-training kernels thread
+per-(client, round) keys; evaluation passes none.
 """
 
 from __future__ import annotations
@@ -22,18 +30,22 @@ def init(key) -> dict:
     return {
         "conv1": nn.conv2d_init(k1, 1, 32, 3),
         "conv2": nn.conv2d_init(k2, 32, 64, 3),
-        # 28 -> conv3 26 -> pool 13 -> conv3 11 -> pool 5; 64·5·5 = 1600
-        "fc1": nn.dense_init(k3, 64 * 5 * 5, 128),
+        # 28 -> conv3 26 -> conv3 24 -> pool 12; 64·12·12 = 9216
+        "fc1": nn.dense_init(k3, 64 * 12 * 12, 128),
         "fc2": nn.dense_init(k4, 128, NUM_CLASSES),
     }
 
 
-def apply(params: dict, x: jnp.ndarray) -> jnp.ndarray:
-    """x: [B, 1, 28, 28] -> logits [B, 10]."""
+def apply(params: dict, x: jnp.ndarray, *, key=None) -> jnp.ndarray:
+    """x: [B, 1, 28, 28] -> logits [B, 10]. Dropout active iff key given."""
     h = nn.relu(nn.conv2d(params["conv1"], x))
-    h = nn.max_pool2d(h)
     h = nn.relu(nn.conv2d(params["conv2"], h))
     h = nn.max_pool2d(h)
+    if key is not None:
+        k1, k2 = jax.random.split(key)
+        h = nn.dropout(k1, h, 0.25, train=True)
     h = h.reshape(h.shape[0], -1)
     h = nn.relu(nn.dense(params["fc1"], h))
+    if key is not None:
+        h = nn.dropout(k2, h, 0.5, train=True)
     return nn.dense(params["fc2"], h)

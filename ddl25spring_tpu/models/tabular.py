@@ -1,8 +1,15 @@
 """Tabular classifier for the heart-disease task.
 
-Capability target: the reference's `HeartDiseaseNN` 4-layer MLP
-(lab/tutorial_2a/centralized.py:13-28) trained on heart.csv with
+Capability target: the reference's `HeartDiseaseNN`
+(lab/tutorial_2a/centralized.py:13-28), reproduced
+architecture-for-architecture: in(30 one-hot features)→64→128→256→2 with
+LeakyReLU activations and dropout(0.1) before the output layer, trained with
 best-state_dict-by-test-accuracy tracking (centralized.py:51,67-70).
+
+Dropout is active iff a PRNG ``key`` is passed. Documented deviation: the
+reference never calls ``model.eval()`` in centralized.py, so its test-time
+forward keeps dropout on; we evaluate deterministically (pass no key), which
+only reduces evaluation noise.
 """
 
 from __future__ import annotations
@@ -15,12 +22,19 @@ import jax.numpy as jnp
 from .. import nn
 
 NUM_CLASSES = 2
+DROPOUT = 0.1
 
 
-def init(key, in_dim: int = 13, hidden: Sequence[int] = (64, 32, 16)) -> list:
+def init(key, in_dim: int = 30, hidden: Sequence[int] = (64, 128, 256)) -> list:
+    """Layer stack [in, *hidden, 2]; defaults are the reference architecture."""
     return nn.mlp_init(key, [in_dim, *hidden, NUM_CLASSES])
 
 
-def apply(params: list, x: jnp.ndarray) -> jnp.ndarray:
-    """x: [B, in_dim] -> logits [B, 2]."""
-    return nn.mlp(params, x)
+def apply(params: list, x: jnp.ndarray, *, key=None) -> jnp.ndarray:
+    """x: [B, in_dim] -> logits [B, 2]. LeakyReLU between layers, dropout
+    before the final layer when a key is given (centralized.py:22-27)."""
+    for layer in params[:-1]:
+        x = nn.leaky_relu(nn.dense(layer, x))
+    if key is not None:
+        x = nn.dropout(key, x, DROPOUT, train=True)
+    return nn.dense(params[-1], x)

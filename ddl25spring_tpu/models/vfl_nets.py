@@ -27,32 +27,75 @@ NUM_CLASSES = 2
 
 # ------------------------------------------------------- discriminative VFL
 
-def init_bottom(key, in_dim: int, out_dim: int = 2, hidden: int = 16) -> list:
-    return nn.mlp_init(key, [in_dim, hidden, out_dim])
+DROPOUT = 0.1
 
 
-def init_top(key, in_dim: int, hidden: int = 16, num_classes: int = NUM_CLASSES) -> list:
-    return nn.mlp_init(key, [in_dim, hidden, num_classes])
+def init_bottom(key, in_dim: int, out_dim: int) -> list:
+    """Reference BottomModel (vfl.py:11-22): fc1 in→out, fc2 out→out, ReLU
+    after each, dropout(0.1) on the output."""
+    return nn.mlp_init(key, [in_dim, out_dim, out_dim])
 
 
-def init_vfl(key, feature_dims: Sequence[int], *, bottom_out: int = 2) -> dict:
-    """One bottom model per party (sized to its feature slice) + the top."""
+def init_top(key, in_dim: int, num_classes: int = NUM_CLASSES) -> list:
+    """Reference TopModel (vfl.py:25-40): concat→128→256→num_classes."""
+    return nn.mlp_init(key, [in_dim, 128, 256, num_classes])
+
+
+def init_vfl(key, feature_dims: Sequence[int], *, bottom_out_mult: int = 2) -> dict:
+    """One bottom model per party (sized to its feature slice) + the top.
+
+    Each party's bottom output width is ``bottom_out_mult · d_i`` — the
+    reference's ``outs_per_client * len(in_feats)`` sizing (vfl.py:139-141),
+    so parties with more features send wider activations up the cut.
+    """
     keys = jax.random.split(key, len(feature_dims) + 1)
-    bottoms = [init_bottom(keys[i], d, bottom_out) for i, d in enumerate(feature_dims)]
-    top = init_top(keys[-1], bottom_out * len(feature_dims))
+    bottoms = [init_bottom(keys[i], d, bottom_out_mult * d)
+               for i, d in enumerate(feature_dims)]
+    top = init_top(keys[-1], sum(bottom_out_mult * d for d in feature_dims))
     return {"bottoms": bottoms, "top": top}
 
 
-def bottoms_forward(params: dict, xs: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
-    """Per-party forward — the activations that cross the cut layer."""
-    return [nn.mlp(b, x, final_activation=nn.relu) for b, x in zip(params["bottoms"], xs)]
+def bottoms_forward(params: dict, xs: Sequence[jnp.ndarray], *,
+                    key=None) -> List[jnp.ndarray]:
+    """Per-party forward — the activations that cross the cut layer.
+    Dropout(0.1) on each party's output iff a key is given (vfl.py:21-22)."""
+    outs = []
+    keys = (jax.random.split(key, len(xs)) if key is not None
+            else [None] * len(xs))
+    for b, x, k in zip(params["bottoms"], xs, keys):
+        h = nn.mlp(b, x, activation=nn.relu, final_activation=nn.relu)
+        if k is not None:
+            h = nn.dropout(k, h, DROPOUT, train=True)
+        outs.append(h)
+    return outs
 
 
-def vfl_forward(params: dict, xs: Sequence[jnp.ndarray]) -> jnp.ndarray:
+def top_forward(params: dict, cut: jnp.ndarray, *, key=None) -> jnp.ndarray:
+    """Server-side classifier over the concatenated cut-layer activations.
+
+    Faithful to the reference quirk (vfl.py:36-40): LeakyReLU is applied
+    after EVERY layer including the output — the 'logits' the CE loss sees
+    are LeakyReLU-activated — and train-mode dropout(0.1) lands on the
+    output too. Reproduced because the published accuracy bands
+    (84.8-85.3% @ 4 clients) were trained through it.
+    """
+    h = nn.mlp(params["top"], cut, activation=nn.leaky_relu,
+               final_activation=nn.leaky_relu)
+    if key is not None:
+        h = nn.dropout(key, h, DROPOUT, train=True)
+    return h
+
+
+def vfl_forward(params: dict, xs: Sequence[jnp.ndarray], *,
+                key=None) -> jnp.ndarray:
     """Full split-NN forward: concat bottom outputs at the server, classify
     (reference: vfl.py:87-89)."""
-    cut = jnp.concatenate(bottoms_forward(params, xs), axis=1)
-    return nn.mlp(params["top"], cut)
+    if key is not None:
+        kb, kt = jax.random.split(key)
+    else:
+        kb = kt = None
+    cut = jnp.concatenate(bottoms_forward(params, xs, key=kb), axis=1)
+    return top_forward(params, cut, key=kt)
 
 
 # ------------------------------------------------------- VFL-VAE hybrid

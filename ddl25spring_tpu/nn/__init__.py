@@ -128,6 +128,7 @@ def dropout(key, x: jnp.ndarray, rate: float, *, train: bool) -> jnp.ndarray:
 # ---------------------------------------------------------------- activations
 
 relu = jax.nn.relu
+leaky_relu = jax.nn.leaky_relu   # default slope 0.01 == torch.nn.LeakyReLU
 silu = jax.nn.silu
 gelu = jax.nn.gelu
 softmax = jax.nn.softmax

@@ -36,7 +36,7 @@ class ClassifierReport:
 def train_classifier(x_train: np.ndarray, y_train: np.ndarray,
                      x_test: np.ndarray, y_test: np.ndarray, *,
                      epochs: int = 200, batch_size: int = 64, lr: float = 1e-3,
-                     hidden=(64, 32, 16), seed: int = 0,
+                     hidden=(64, 128, 256), seed: int = 0,
                      log_every: int = 0,
                      log_fn: Callable[[str], None] = print
                      ) -> Tuple[list, ClassifierReport]:
@@ -52,26 +52,31 @@ def train_classifier(x_train: np.ndarray, y_train: np.ndarray,
 
     def minibatch_step(carry, batch):
         params, opt_state = carry
-        x, y, m = batch
+        x, y, m, key = batch
 
         def loss_fn(p):
-            return cross_entropy_loss(tabular.apply(p, x), y, m)
+            return cross_entropy_loss(tabular.apply(p, x, key=key), y, m)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state), loss * m.sum()
 
     @jax.jit
-    def epoch_fn(params, opt_state):
+    def epoch_fn(params, opt_state, epoch_key):
+        keys = jax.random.split(epoch_key, yb.shape[0])
         (params, opt_state), losses = jax.lax.scan(
-            minibatch_step, (params, opt_state), (xb, yb, mb))
+            minibatch_step, (params, opt_state), (xb, yb, mb, keys))
+        # Evaluation is deterministic (no dropout key) — the reference omits
+        # model.eval() here (a quirk we do not reproduce; see models.tabular).
         acc = (tabular.apply(params, xt).argmax(-1) == yt).mean()
         return params, opt_state, losses.sum() / mb.sum(), acc
 
     report = ClassifierReport()
     best_params = params
+    dropout_key = jax.random.key(seed + 1)
     for epoch in range(epochs):
-        params, opt_state, loss, acc = epoch_fn(params, opt_state)
+        params, opt_state, loss, acc = epoch_fn(
+            params, opt_state, jax.random.fold_in(dropout_key, epoch))
         acc = float(acc)
         report.train_losses.append(float(loss))
         report.test_accuracies.append(acc)

@@ -58,7 +58,7 @@ def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
     cfg = cfg or VFLConfig()
     feature_dims = [int(a.shape[1]) for a in xs_train]
     params = vfl_nets.init_vfl(jax.random.key(cfg.seed), feature_dims,
-                               bottom_out=cfg.bottom_out_dim)
+                               bottom_out_mult=cfg.bottom_out_mult)
     optimizer = optax.adam(cfg.lr)
     opt_state = optimizer.init(params)
 
@@ -66,10 +66,10 @@ def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
 
     def minibatch_step(carry, batch):
         params, opt_state = carry
-        xs, y, m = batch
+        xs, y, m, key = batch
 
         def loss_fn(p):
-            logits = vfl_nets.vfl_forward(p, xs)
+            logits = vfl_nets.vfl_forward(p, xs, key=key)
             return cross_entropy_loss(logits, y, m), logits
 
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -79,9 +79,10 @@ def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
         return (params, opt_state), (loss * m.sum(), correct, m.sum())
 
     @jax.jit
-    def epoch_fn(params, opt_state):
+    def epoch_fn(params, opt_state, epoch_key):
+        keys = jax.random.split(epoch_key, y_b.shape[0])
         (params, opt_state), (losses, correct, counts) = jax.lax.scan(
-            minibatch_step, (params, opt_state), (xs_b, y_b, m_b))
+            minibatch_step, (params, opt_state), (xs_b, y_b, m_b, keys))
         n = counts.sum()
         return params, opt_state, losses.sum() / n, correct.sum() / n
 
@@ -91,8 +92,10 @@ def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
         return (logits.argmax(-1) == jnp.asarray(y_test)).mean()
 
     report = VFLReport()
+    dropout_key = jax.random.key(cfg.seed + 1)
     for epoch in range(cfg.epochs):
-        params, opt_state, loss, acc = epoch_fn(params, opt_state)
+        params, opt_state, loss, acc = epoch_fn(
+            params, opt_state, jax.random.fold_in(dropout_key, epoch))
         report.train_losses.append(float(loss))
         report.train_accuracies.append(float(acc))
         if log_every and epoch % log_every == 0:
