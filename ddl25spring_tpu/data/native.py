@@ -36,11 +36,31 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    try:
+        src = os.path.join(os.path.abspath(_NATIVE_DIR), "tokenstream.cpp")
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+
+
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build():
+    # Rebuild when the source is newer than the .so (the library is never
+    # committed, only built here). flock serializes concurrent first-loads —
+    # multi-process launches must not dlopen a half-written library.
+    if _stale():
+        import fcntl
+        with open(os.path.join(os.path.abspath(_NATIVE_DIR), ".build.lock"),
+                  "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if _stale():
+                _build()
+    if not os.path.exists(_LIB_PATH):
         raise OSError("native tokenstream library unavailable "
                       f"(build failed; see {_NATIVE_DIR})")
     lib = ctypes.CDLL(_LIB_PATH)

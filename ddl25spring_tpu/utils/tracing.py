@@ -126,9 +126,28 @@ class ResultSink:
         new_file = self._fieldnames is None
         if new_file:
             self._fieldnames = list(row.keys())
+        extra = [k for k in row if k not in self._fieldnames]
+        if extra:
+            # Widen: rewrite the file under the union header instead of
+            # silently dropping the new fields. Pure-csv round-trip (no type
+            # inference mangling existing values) via a temp file + atomic
+            # replace so a crash mid-widen cannot lose prior records.
+            self._fieldnames = self._fieldnames + extra
+            if os.path.exists(self.path):
+                import tempfile
+                with open(self.path, newline="") as f:
+                    old_rows = list(csv.DictReader(f))
+                fd, tmp = tempfile.mkstemp(
+                    dir=os.path.dirname(self.path) or ".", suffix=".csv")
+                with os.fdopen(fd, "w", newline="") as f:
+                    writer = csv.DictWriter(f, fieldnames=self._fieldnames,
+                                            restval="")
+                    writer.writeheader()
+                    writer.writerows(old_rows)
+                os.replace(tmp, self.path)
         with open(self.path, "a", newline="") as f:
             writer = csv.DictWriter(f, fieldnames=self._fieldnames,
-                                    extrasaction="ignore")
+                                    restval="")
             if new_file:
                 writer.writeheader()
             writer.writerow(row)

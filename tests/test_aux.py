@@ -137,3 +137,84 @@ def test_process_info_single_host():
     info = distributed.process_info()
     assert info["num_processes"] == 1
     assert info["global_devices"] >= 8
+
+
+def test_result_sink_widens_header(tmp_path):
+    """A record with new fields widens the CSV instead of silently dropping
+    them (round-1 advisor finding)."""
+    path = str(tmp_path / "wide.csv")
+    sink = ResultSink(path)
+    sink.write({"a": 1, "b": 2})
+    sink.write({"a": 3, "b": 4, "c": 5})
+    df = sink.read_df()
+    assert list(df.columns) == ["a", "b", "c"]
+    assert df["c"].tolist()[1] == 5
+    assert np.isnan(df["c"].tolist()[0])
+
+
+def test_initialize_is_noop_without_rendezvous_config(monkeypatch):
+    """Single-host: no coordinator env vars ⇒ initialize() returns without
+    touching jax.distributed (the reference's init_process_group analog is
+    only needed multi-host)."""
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    distributed.initialize()
+    assert not jax.distributed.is_initialized()
+
+
+def test_initialize_short_circuits_when_already_initialized(monkeypatch):
+    """If the rendezvous already happened, initialize() must not re-read env
+    vars or re-initialize (idempotence across entry points)."""
+    calls = []
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    distributed.initialize(coordinator_address="203.0.113.1:1234",
+                           num_processes=2, process_id=0)
+    assert calls == []
+
+
+def test_initialize_forwards_rendezvous_args(monkeypatch):
+    """Explicit args (or env vars) reach jax.distributed.initialize — the
+    MASTER_ADDR/MASTER_PORT convention without per-rank processes."""
+    calls = []
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    distributed.initialize(coordinator_address="203.0.113.1:1234",
+                           num_processes=4, process_id=2)
+    assert calls == [{"coordinator_address": "203.0.113.1:1234",
+                      "num_processes": 4, "process_id": 2}]
+    calls.clear()
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "203.0.113.9:999")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    distributed.initialize()
+    assert calls == [{"coordinator_address": "203.0.113.9:999",
+                      "num_processes": 2, "process_id": 1}]
+
+
+def test_hybrid_mesh_axis_ordering(devices):
+    """DCN axes outer, ICI axes inner, but the resulting Mesh axis order is
+    canonical (mesh.AXES) so the dp/pp/tp/sp/ep step factories compose."""
+    mesh = distributed.hybrid_mesh({"model": 2}, {"data": 4},
+                                   devices=devices[:8])
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape == {"data": 4, "model": 2}
+    # Adjacent devices (same would-be host) sit along the ICI (model) axis:
+    # the dcn axis strides over them.
+    arr = np.asarray(mesh.devices)
+    ids = np.vectorize(lambda d: d.id)(arr)
+    assert ids.tolist() == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+
+def test_hybrid_mesh_three_axes(devices):
+    mesh = distributed.hybrid_mesh({"stage": 2, "model": 2}, {"data": 2},
+                                   devices=devices[:8])
+    assert mesh.axis_names == ("data", "stage", "model")
+    assert dict(mesh.shape) == {"data": 2, "stage": 2, "model": 2}
+
+
+def test_hybrid_mesh_rejects_axis_in_both_tiers(devices):
+    with pytest.raises(AssertionError):
+        distributed.hybrid_mesh({"data": 2}, {"data": 2}, devices=devices[:4])
