@@ -58,8 +58,14 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     dtype: str = "float32"             # computation dtype ("bfloat16" on TPU)
     param_dtype: str = "float32"
-    # Attention backend: "xla" (einsum softmax) or "pallas" (fused flash kernel).
-    attention_impl: str = "xla"
+    # Attention backend: "xla" (fused-softmax dot_generals), "pallas" (the
+    # flash kernel), or "auto" (pallas iff running on TPU and the sequence is
+    # at least ``flash_min_seq``). The crossover is measured, not guessed:
+    # at Dh=48 the flash kernel pads lanes to 128, so XLA wins until the
+    # O(T²) score tensor dominates around T≈4k (measured on v5e by
+    # experiments/attn_bench.py).
+    attention_impl: str = "auto"
+    flash_min_seq: int = 4096
     # Rematerialize block activations in backward (jax.checkpoint) — trades
     # FLOPs for HBM, the TPU-native answer to activation memory pressure.
     remat: bool = False

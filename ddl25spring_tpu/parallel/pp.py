@@ -1,8 +1,10 @@
-"""Pipeline parallelism: GPipe microbatch schedule as one SPMD program.
+"""Pipeline parallelism: GPipe and 1F1B microbatch schedules as one SPMD program.
 
 Capability target (NOT a port): the reference's three pipeline variants —
 - naive 3-stage PP: one batch flows stage0→1→2 forward then back with
-  blocking send/recv (reference: lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py:27-99);
+  blocking send/recv (reference: lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py:27-99
+  — the file is *named* 1F1B but implements a naive schedule; here 1F1B is
+  actually implemented, see `_pipeline_1f1b_loss_and_grad`);
 - microbatched GPipe: batch split into microbatches streamed with
   isend/irecv(tag=itr), grads accumulated across microbatches, one step per
   iteration (lab/tutorial_1a/homework_1_b1.py:50-144);
@@ -45,7 +47,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import LlamaConfig
 from ..models import llama
-from ..ops import causal_lm_loss
 from .dp import TrainState, sharded_opt_init
 
 
@@ -144,7 +145,7 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             valid = is_last & (out_i >= 0)
             mb_loss = lax.cond(
                 valid,
-                lambda: causal_lm_loss(llama.head(p, h, cfg), tok_out),
+                lambda: llama.head_loss(p, h, tok_out, cfg),
                 lambda: jnp.zeros((), jnp.float32))
             # The hop: activations ride the ICI ring to the next stage. The
             # last→first edge carries bubble garbage that stage 0 discards.
@@ -163,6 +164,11 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         return loss_sum / n_microbatches / tp
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
+    return _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp)
+
+
+def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
+    """Cross-stage/model/data reductions shared by both schedules."""
     loss = lax.psum(loss, "stage") * tp  # broadcast + undo 1/tp for reporting
 
     def reduce_grad(name, g):
@@ -190,14 +196,119 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     return loss, grads
 
 
+def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+                                 n_stages: int, n_microbatches: int,
+                                 has_data_axis: bool,
+                                 tp: int = 1) -> Tuple[jnp.ndarray, dict]:
+    """1F1B (one-forward-one-backward) schedule, hand-written backward.
+
+    GPipe (above) lets autodiff transpose the whole forward scan, which means
+    every tick's stage input — n_microbatches + n_stages − 1 activations —
+    is saved for the backward replay: activation memory grows linearly with
+    the microbatch count. 1F1B interleaves each microbatch's backward as soon
+    as its forward clears the last stage, so at most ``2·n_stages − 1``
+    microbatch inputs are ever in flight per stage (Megatron-LM's memory
+    argument; the bubble fraction itself matches GPipe). Because a ``vjp``
+    closure cannot ride a ``lax.scan`` carry, the backward recomputes the
+    stage forward from the stashed *input* — the standard full-recompute
+    (remat) variant, so the fair time comparison is GPipe with
+    ``cfg.remat=True`` (see experiments/pp_schedules.py for measurements).
+
+    Schedule (SPMD lockstep; iteration j does one F then one B sub-tick):
+    - F: stage s runs microbatch ``i_f = j − s``            (valid if 0≤i_f<M)
+    - B: stage s runs microbatch ``i_b = j − 2(S−1) + s``   (valid if 0≤i_b<M)
+    so the last stage backs up microbatch i immediately after forwarding it
+    (same j), and the cotangent hops one stage down the ring per iteration.
+    Gradient semantics are identical to GPipe: mean loss over microbatches,
+    grads accumulated across B sub-ticks, one optimizer step per call.
+    """
+    stage = lax.axis_index("stage")
+    is_first = stage == 0
+    is_last = stage == n_stages - 1
+    tp_axis = "model" if tp > 1 else None
+    b, t = tokens.shape
+    assert b % n_microbatches == 0, (b, n_microbatches)
+    mb = b // n_microbatches
+    tokens_mb = tokens.reshape(n_microbatches, mb, t)
+    n_iters = n_microbatches + 2 * (n_stages - 1)
+    n_slots = min(2 * n_stages - 1, n_microbatches)
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    dt = jnp.dtype(cfg.dtype)
+
+    def stage_fn(p: dict, act_in: jnp.ndarray, i: jnp.ndarray):
+        """One stage application for microbatch index i (clipped): embeds on
+        the first stage, computes the (masked) loss on the last."""
+        tok = tokens_mb[jnp.clip(i, 0, n_microbatches - 1)]
+        x_in = jnp.where(is_first[..., None, None, None],
+                         llama.embed(p, tok, cfg), act_in)
+        h = llama.blocks_apply(p["blocks"], x_in, cfg, tp_axis=tp_axis)
+        mb_loss = lax.cond(
+            is_last,
+            lambda: llama.head_loss(p, h, tok, cfg),
+            lambda: jnp.zeros((), jnp.float32))
+        return h, mb_loss
+
+    def iteration(carry, j):
+        stash, grads, loss_sum, x_fwd, g_bwd = carry
+
+        # --- F sub-tick: forward microbatch i_f, stash its input ----------
+        i_f = j - stage
+        valid_f = (i_f >= 0) & (i_f < n_microbatches)
+        act_in = x_fwd
+        h, _ = stage_fn(params, act_in, i_f)
+        slot_f = jnp.clip(i_f, 0, n_microbatches - 1) % n_slots
+        old = lax.dynamic_index_in_dim(stash, slot_f, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(valid_f, act_in, old), slot_f, axis=0)
+        x_fwd = lax.ppermute(h, "stage", fwd_perm)
+
+        # --- B sub-tick: vjp-recompute microbatch i_b from its stash ------
+        i_b = j - 2 * (n_stages - 1) + stage
+        valid_b = (i_b >= 0) & (i_b < n_microbatches)
+        slot_b = jnp.clip(i_b, 0, n_microbatches - 1) % n_slots
+        act_b = lax.dynamic_index_in_dim(stash, slot_b, keepdims=False)
+        (_, mb_loss), pull = jax.vjp(
+            lambda p, a: stage_fn(p, a, i_b), params, act_b)
+        # Cotangent seeds: the last stage seeds from its own loss (scaled for
+        # the microbatch mean and the TP loss-replica double count, as in
+        # GPipe's loss_fn); every other stage seeds from the cotangent that
+        # arrived down the ring. Invalid sub-ticks seed zero, which makes
+        # their (finite) recomputed grads exactly zero — no masking needed.
+        g_h = jnp.where((is_last | ~valid_b)[..., None, None, None],
+                        jnp.zeros_like(g_bwd), g_bwd)
+        g_loss = jnp.where(is_last & valid_b, 1.0 / (n_microbatches * tp), 0.0)
+        dp, da = pull((g_h, g_loss.astype(jnp.float32)))
+        grads = jax.tree.map(jnp.add, grads, dp)
+        loss_sum = loss_sum + jnp.where(is_last & valid_b, mb_loss, 0.0)
+        g_bwd = lax.ppermute(da.astype(dt), "stage", bwd_perm)
+
+        return (stash, grads, loss_sum, x_fwd, g_bwd), None
+
+    stash0 = jnp.zeros((n_slots, mb, t, cfg.dmodel), dt)
+    grads0 = jax.tree.map(jnp.zeros_like, params)
+    act0 = jnp.zeros((mb, t, cfg.dmodel), dt)
+    (_, grads, loss_sum, _, _), _ = lax.scan(
+        iteration,
+        (stash0, grads0, jnp.zeros((), jnp.float32), act0, act0),
+        jnp.arange(n_iters))
+    return _reduce_loss_and_grads(loss_sum / n_microbatches / tp, grads,
+                                  tp_axis, has_data_axis, tp)
+
+
 def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
-                       mesh: Mesh, n_microbatches: int = 1) -> Callable:
-    """jit-compiled GPipe train step over mesh axes (data, stage).
+                       mesh: Mesh, n_microbatches: int = 1,
+                       schedule: str = "gpipe") -> Callable:
+    """jit-compiled pipeline train step over mesh axes (data, stage).
 
     ``n_microbatches=1`` degenerates to the reference's naive staged pipeline
     (intro_PP_1F1B.py); ``>1`` is the homework_1_b1 GPipe schedule; a mesh
     with ``data > 1`` is the homework_1_b2 DP×PP topology; adding a
     ``model`` axis gives the full 3-D DP×PP×TP layout.
+
+    ``schedule`` selects "gpipe" (autodiff-transposed forward scan, O(M)
+    activation memory) or "1f1b" (interleaved hand-written backward, O(S)
+    activation memory) — both compute the identical gradient.
 
     Returns ``step(state, tokens) -> (state, loss)`` where tokens is the
     global [B, T] batch, B divisible by data_size · n_microbatches.
@@ -205,10 +316,12 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     n_stages = mesh.shape["stage"]
     has_data = mesh.shape.get("data", 1) > 1
     tp = mesh.shape.get("model", 1)
+    body = {"gpipe": _pipeline_loss_and_grad,
+            "1f1b": _pipeline_1f1b_loss_and_grad}[schedule]
 
     def sharded_grads(params, tokens):
-        return _pipeline_loss_and_grad(params, tokens, cfg, n_stages,
-                                       n_microbatches, has_data, tp)
+        return body(params, tokens, cfg, n_stages,
+                    n_microbatches, has_data, tp)
 
     def step(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
         specs = param_specs(state.params, tp=tp > 1)

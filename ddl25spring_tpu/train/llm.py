@@ -21,7 +21,6 @@ import optax
 from ..config import LlamaConfig, TrainConfig
 from ..data.tokens import sharded_batches
 from ..models import llama
-from ..ops import causal_lm_loss
 from ..parallel import dp, make_mesh
 from ..tokenizers import load_tokenizer
 
@@ -61,8 +60,10 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     state = dp.replicate(mesh, dp.init_state(params, optimizer))
 
     def loss_fn(p, batch):
-        logits = llama.forward(p, batch, model_cfg)
-        return causal_lm_loss(logits, batch)
+        # Fused head+CE: never materializes the [B, T, V] logits (the step's
+        # dominant HBM tensor at real vocab sizes). Equivalent math to
+        # causal_lm_loss(llama.forward(...)) — asserted in tests/test_core.py.
+        return llama.forward_loss(p, batch, model_cfg)
 
     make_step = (dp.make_grad_aggregation_step if aggregation == "gradient"
                  else dp.make_weight_aggregation_step)

@@ -1,0 +1,87 @@
+"""Shared experiment plumbing: data setup, provenance labels, result sinks."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ddl25spring_tpu.config import FLConfig
+from ddl25spring_tpu.data import mnist, tabular
+from ddl25spring_tpu.fl import federate
+from ddl25spring_tpu.fl.federated_data import FederatedDataset
+from ddl25spring_tpu.models import mnist_cnn
+from ddl25spring_tpu.utils.tracing import ResultSink
+
+RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+def sink(name: str) -> ResultSink:
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    path = os.path.join(RESULTS_DIR, name)
+    if os.path.exists(path):
+        os.remove(path)  # each runner owns its file; re-runs replace it
+    return ResultSink(path)
+
+
+def mnist_provenance() -> str:
+    """Whether load_mnist() will return real IDX files or the synthetic
+    fallback (mirrors its search order)."""
+    for d in (os.environ.get("DDL_MNIST_DIR"), "data/mnist"):
+        if d and os.path.isdir(d):
+            return "mnist-real"
+    return "mnist-synthetic"
+
+
+def heart_provenance() -> str:
+    for c in (os.environ.get("DDL_HEART_CSV"), *tabular._SEARCH):
+        if c and os.path.exists(c):
+            return "heart-real"
+    return "heart-synthetic"
+
+
+def tinystories_provenance() -> str:
+    from ddl25spring_tpu.data import tokens
+    for c in (os.environ.get("DDL_TINYSTORIES"), *tokens._DEFAULT_CORPUS):
+        if c and os.path.exists(c):
+            return "tinystories-real"
+    return "tinystories-synthetic"
+
+
+def mnist_fl_setup(cfg: FLConfig, *, n_train: int = 60000, n_test: int = 10000
+                   ) -> Tuple[dict, FederatedDataset, np.ndarray, np.ndarray]:
+    """(init_params, federated train data, test_x, test_y) at the reference's
+    MNIST setup: normalize with (0.1307, 0.3081), split IID or the
+    sort-into-2N-shards non-IID scheme, stack on the client axis."""
+    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=n_train, n_test=n_test,
+                                            seed=0)
+    x = mnist.normalize(x_raw)
+    xt = mnist.normalize(xt_raw)
+    subsets = mnist.split(y, cfg.nr_clients, iid=cfg.iid, seed=cfg.seed)
+    data = federate(x, y.astype(np.int32), subsets)
+    params = mnist_cnn.init(jax.random.key(0))
+    return params, data, xt, yt.astype(np.int32)
+
+
+def heart_vfl_setup(nr_clients: int, partitioner: str = "base", *,
+                    seed: int = 0, min_features: int = 2):
+    """(xs_train, y_train, xs_test, y_test, names) vertically partitioned.
+
+    ``partitioner``: "base" (the tutorial's 4-way fixed split becomes an even
+    deal over base features), "even", or "min2" — hw2's two policies.
+    """
+    X, y = tabular.load_heart()
+    feats, names = tabular.preprocess(X)
+    x_tr, y_tr, x_te, y_te = tabular.train_test_split(feats, y, seed=seed)
+    if partitioner == "even":
+        parts = tabular.split_features_evenly(names, nr_clients, seed=seed)
+    elif partitioner == "min2":
+        parts = tabular.split_features_with_minimum(
+            names, nr_clients, min_features=min_features, seed=seed)
+    else:
+        parts = tabular.split_features_evenly(names, nr_clients)
+    xs_tr = [x_tr[:, p] for p in parts]
+    xs_te = [x_te[:, p] for p in parts]
+    return xs_tr, y_tr, xs_te, y_te, names

@@ -1,0 +1,130 @@
+"""hw3 robust-aggregation grid under 20% gradient reversion.
+
+Reproduces the reference's homework-3 experiment battery
+(lab/hw03/Tea_Pula_03.ipynb):
+- cells 3-9:  {none, krum, multi-krum, majority-sign} × {IID, non-IID}
+  10-round accuracy curves under 20% AttackerGradientReversion, at the hw3
+  setting lr=0.02, B=200, C=0.2, E=2, seed=42 (N=100 ⇒ 20 clients/round,
+  4 malicious per round in expectation).
+- cell 18: Bulyan over k ∈ {10, 14, 18} × β ∈ {0.2, 0.4, 0.6}.
+- cell 29: SparseFed over top-k ∈ {20, 40, 60, 80}%.
+
+Per-round curves land in ``experiments/results/hw3_defenses.csv`` /
+``hw3_bulyan.csv`` / ``hw3_sparsefed.csv`` (the notebook's cell-11 CSV-dump
+idiom); render with ``python -m experiments.plots``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+
+from ddl25spring_tpu.config import FLConfig
+from ddl25spring_tpu.fl import FedAvgGradServer
+from ddl25spring_tpu.fl import attacks as atk
+from ddl25spring_tpu.fl import defenses as dfn
+from ddl25spring_tpu.models import mnist_cnn
+
+from . import common
+
+# hw3 setting (Tea_Pula_03.ipynb cell 3): the attack analysis deliberately
+# runs hotter than homework-1 defaults.
+HW3 = dict(nr_clients=100, client_fraction=0.2, batch_size=200, epochs=2,
+           lr=0.02, seed=42)
+MALICIOUS_FRACTION = 0.2
+
+
+def _defense_hook(name: str, n_mal: int, **kw):
+    """Map a defense name to the (deltas, weights) -> aggregate hook."""
+    if name == "none":
+        return None
+    if name == "krum":
+        return dfn.selection_defense(dfn.krum, n_malicious=n_mal)
+    if name == "multi_krum":
+        return dfn.selection_defense(dfn.multi_krum, n_malicious=n_mal,
+                                     k=kw.get("k", 10))
+    if name == "majority_sign":
+        return dfn.coordinate_defense(dfn.majority_sign)
+    if name == "bulyan":
+        return dfn.coordinate_defense(dfn.bulyan, n_malicious=n_mal,
+                                      k=kw["k"], beta=kw["beta"])
+    if name == "sparse_fed":
+        return dfn.coordinate_defense(dfn.sparse_fed,
+                                      topk_fraction=kw["topk_fraction"])
+    raise ValueError(name)
+
+
+def run_one(defense: str, iid: bool, sink, provenance: str, *, rounds: int,
+            n_train: int, n_test: int, extra: Optional[dict] = None) -> float:
+    extra = extra or {}
+    cfg = FLConfig(rounds=rounds, iid=iid, **HW3)
+    params, data, xt, yt = common.mnist_fl_setup(cfg, n_train=n_train,
+                                                 n_test=n_test)
+    mask = atk.injection_mask(cfg.nr_clients, MALICIOUS_FRACTION, cfg.seed)
+    n_mal = int(MALICIOUS_FRACTION * cfg.clients_per_round)
+    server = FedAvgGradServer(
+        params, mnist_cnn.apply, data, xt, yt, cfg,
+        adversary=(mask, atk.GradientReversion(scale=5.0)),
+        defense=_defense_hook(defense, n_mal, **extra))
+    result = server.run(cfg.rounds)
+    df = result.as_df()
+    df["data"] = provenance
+    df["defense"] = defense
+    df["iid"] = iid
+    df["attack"] = "gradient_reversion_20pct"
+    for k, v in extra.items():
+        df[k] = v
+    for row in df.to_dict(orient="records"):
+        sink.write(row)
+    return result.test_accuracy[-1]
+
+
+def main(quick: bool = False) -> Dict[str, float]:
+    provenance = common.mnist_provenance()
+    n_train, n_test = (2000, 500) if quick else (60000, 10000)
+    rounds = 2 if quick else 10
+    finals: Dict[str, float] = {}
+
+    # --- the defense × split grid (cells 3-9) ---------------------------
+    sink = common.sink("hw3_defenses.csv")
+    for defense in ("none", "krum", "multi_krum", "majority_sign"):
+        for iid in (True, False):
+            acc = run_one(defense, iid, sink, provenance, rounds=rounds,
+                          n_train=n_train, n_test=n_test)
+            finals[f"{defense}/{'iid' if iid else 'noniid'}"] = acc
+            print(f"{defense:13s} {'IID' if iid else 'non-IID':7s}: "
+                  f"final acc {acc:.4f}")
+
+    # --- Bulyan k × β (cell 18) -----------------------------------------
+    sink_b = common.sink("hw3_bulyan.csv")
+    ks = (10,) if quick else (10, 14, 18)
+    betas = (0.2,) if quick else (0.2, 0.4, 0.6)
+    for k in ks:
+        for beta in betas:
+            acc = run_one("bulyan", True, sink_b, provenance, rounds=rounds,
+                          n_train=n_train, n_test=n_test,
+                          extra={"k": k, "beta": beta})
+            finals[f"bulyan/k{k}/b{beta}"] = acc
+            print(f"bulyan k={k} beta={beta}: final acc {acc:.4f}")
+
+    # --- SparseFed top-k% (cell 29) -------------------------------------
+    sink_s = common.sink("hw3_sparsefed.csv")
+    topks = (0.4,) if quick else (0.2, 0.4, 0.6, 0.8)
+    for tk in topks:
+        acc = run_one("sparse_fed", True, sink_s, provenance, rounds=rounds,
+                      n_train=n_train, n_test=n_test,
+                      extra={"topk_fraction": tk})
+        finals[f"sparse_fed/{int(tk*100)}pct"] = acc
+        print(f"sparse_fed top-{int(tk*100)}%: final acc {acc:.4f}")
+
+    print(f"-> {sink.path}, {sink_b.path}, {sink_s.path} [{provenance}]")
+    return finals
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(quick=ap.parse_args().quick)

@@ -1,0 +1,93 @@
+"""Accuracy/loss-curve rendering from the persisted experiment CSVs.
+
+The reference re-plots its homework results from CSV dumps in notebook cells
+(lab/hw03/Tea_Pula_03.ipynb cell 11; seaborn line plots in hw01 cell 27).
+This is the framework's equivalent: ``python -m experiments.plots`` renders
+every known results CSV under ``experiments/results/`` into PNGs next to it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import common
+
+
+def _mpl():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def plot_fl_curves(csv_name: str, out_name: Optional[str] = None,
+                   group_cols=("algorithm", "N", "C")) -> Optional[str]:
+    """Per-round test-accuracy curves, one line per config group."""
+    import pandas as pd
+    path = os.path.join(common.RESULTS_DIR, csv_name)
+    if not os.path.exists(path):
+        return None
+    df = pd.read_csv(path)
+    group_cols = [c for c in group_cols if c in df.columns]
+    if not group_cols or "round" not in df.columns:
+        return None
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for key, g in df.groupby(group_cols):
+        label = "/".join(str(k) for k in (key if isinstance(key, tuple) else (key,)))
+        ax.plot(g["round"], g["test_accuracy"], marker="o", ms=3, label=label)
+    ax.set_xlabel("round")
+    ax.set_ylabel("test accuracy")
+    ax.set_title(csv_name.replace(".csv", ""))
+    ax.legend(fontsize=7, ncol=2)
+    ax.grid(alpha=0.3)
+    out = os.path.join(common.RESULTS_DIR, out_name or csv_name.replace(".csv", ".png"))
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    plt.close(fig)
+    return out
+
+
+def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None
+                    ) -> Optional[str]:
+    import pandas as pd
+    path = os.path.join(common.RESULTS_DIR, csv_name)
+    if not os.path.exists(path):
+        return None
+    df = pd.read_csv(path)
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for yc in ys:
+        if yc in df.columns:
+            ax.plot(df[x], df[yc], label=yc)
+    ax.set_xlabel(x)
+    ax.set_ylabel("loss")
+    ax.set_title(csv_name.replace(".csv", ""))
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    out = os.path.join(common.RESULTS_DIR, out_name or csv_name.replace(".csv", ".png"))
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    plt.close(fig)
+    return out
+
+
+def main() -> list:
+    made = [
+        plot_fl_curves("hw1_fl.csv"),
+        plot_fl_curves("hw3_defenses.csv",
+                       group_cols=("defense", "iid")),
+        plot_fl_curves("hw3_bulyan.csv", group_cols=("k", "beta")),
+        plot_fl_curves("hw3_sparsefed.csv", group_cols=("topk_fraction",)),
+        plot_loss_curve("hw1b_llm_loss.csv", "iter", ["loss"]),
+        plot_loss_curve("hw2_vfl_vae.csv", "epoch", ["total", "recon", "kl"]),
+    ]
+    made = [m for m in made if m]
+    for m in made:
+        print(f"-> {m}")
+    return made
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""GPipe vs 1F1B pipeline schedule measurements: step time + compiled memory.
+
+The reference names 1F1B but implements a naive schedule
+(lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py); this framework implements both
+GPipe (autodiff-transposed scan) and true interleaved 1F1B
+(parallel/pp.py). Their gradients are bit-equivalent (tests/test_pp.py);
+what differs is the resource profile:
+
+- GPipe saves every tick's stage input for the backward replay — activation
+  memory O(n_microbatches).
+- 1F1B stashes at most 2·n_stages−1 microbatch inputs and rematerializes the
+  stage forward in its hand-written backward — memory O(n_stages), compute
+  +1 forward per microbatch (Megatron-LM's full-recompute setting). The
+  matched-memory GPipe comparison point is ``remat=True``.
+
+The bench host has ONE real chip, so a multi-stage mesh cannot run on real
+hardware here; measurements run on the virtual 8-device CPU mesh (wall
+times are therefore *relative*, not TPU numbers) and, hardware-independent,
+the XLA-compiled per-device temp-buffer sizes from ``compiled.memory_
+analysis()`` — the activation-memory claim is visible there. Results →
+``experiments/results/pp_schedules.csv``.
+
+Run with the CPU pin (the same recipe as tests/conftest.py):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m experiments.pp_schedules
+(python -m experiments.run_all does NOT include this module for that
+reason; __main__ below applies the pin itself before importing jax.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict
+
+
+def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
+            repeats: int = 5) -> Dict[str, Dict[str, float]]:
+    import jax
+    import numpy as np
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import make_mesh, pp
+
+    cfg = LlamaConfig(vocab_size=512, dmodel=64, num_heads=4, n_layers=6,
+                      ctx_size=64)
+    devices = jax.devices()[:n_stages]
+    mesh = make_mesh({"stage": n_stages}, devices=devices)
+    optimizer = optax.sgd(0.1)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch_per_mb * n_microbatches, cfg.ctx_size), 0,
+        cfg.vocab_size)
+
+    out: Dict[str, Dict[str, float]] = {}
+    for schedule in ("gpipe", "1f1b"):
+        params = llama.init_llama(jax.random.key(0), cfg)
+        state = pp.init_state(mesh, params, optimizer)
+        step = pp.make_pipeline_step(cfg, optimizer, mesh, n_microbatches,
+                                     schedule=schedule)
+        batch = pp.shard_batch(mesh, tokens)
+        lowered = step.lower(state, batch)
+        compiled = lowered.compile()
+        mem = compiled.memory_analysis()
+        temp_bytes = getattr(mem, "temp_size_in_bytes", None)
+
+        state, loss = step(state, batch)          # compile+first run
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            state, loss = step(state, batch)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / repeats * 1e3
+        out[schedule] = {"step_ms": dt,
+                         "temp_bytes": float(temp_bytes or 0),
+                         "loss": float(loss)}
+    return out
+
+
+def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
+    from . import common
+    sink = common.sink("pp_schedules.csv")
+    grid = [(2, 8)] if quick else [(2, 8), (4, 16), (8, 32)]
+    results = {}
+    for s, m in grid:
+        r = measure(s, m)
+        for schedule, vals in r.items():
+            sink.write({"n_stages": s, "n_microbatches": m,
+                        "schedule": schedule, **vals})
+            print(f"S={s} M={m:2d} {schedule:6s}: {vals['step_ms']:8.1f} ms  "
+                  f"temp {vals['temp_bytes']/1e6:8.1f} MB  "
+                  f"loss {vals['loss']:.4f}")
+        results[(s, m)] = r
+    print(f"-> {sink.path}")
+    return results
+
+
+if __name__ == "__main__":
+    import os
+
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(quick=ap.parse_args().quick)

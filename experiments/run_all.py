@@ -1,0 +1,43 @@
+"""Run the full parity-evidence suite: every homework experiment battery,
+then render plots. ``--quick`` shrinks datasets/rounds for smoke testing
+(the committed results under experiments/results/ come from a full run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(quick: bool = False, skip=()) -> dict:
+    from . import generative, hw1_fl, hw1b_llm, hw2_vfl, hw3_defenses, plots
+
+    summary = {}
+    stages = [
+        ("hw1_fl", hw1_fl.main),
+        ("hw1b_llm", hw1b_llm.main),
+        ("hw2_vfl", hw2_vfl.main),
+        ("hw3_defenses", hw3_defenses.main),
+        ("generative", generative.main),
+    ]
+    for name, fn in stages:
+        if name in skip:
+            continue
+        t0 = time.perf_counter()
+        print(f"=== {name} ===")
+        out = fn(quick=quick)
+        summary[name] = {str(k): (round(v, 4) if isinstance(v, float) else v)
+                         for k, v in out.items()}
+        print(f"=== {name} done in {time.perf_counter() - t0:.1f}s ===\n")
+    plots.main()
+    print(json.dumps(summary, indent=1))
+    return summary
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--skip", nargs="*", default=[])
+    a = ap.parse_args()
+    main(quick=a.quick, skip=set(a.skip))

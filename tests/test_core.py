@@ -86,3 +86,52 @@ def test_tree_stack_unstack_index():
 
 def test_eight_virtual_devices(devices):
     assert len(devices) == 8
+
+
+# ------------------------------------------------ fused cross-entropy
+
+def test_fused_linear_cross_entropy_matches_unfused():
+    from ddl25spring_tpu.ops.losses import (cross_entropy_loss,
+                                            fused_linear_cross_entropy)
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    n, d, v = 70, 16, 97          # deliberately not chunk-size aligned
+    h = jax.random.normal(k1, (n, d))
+    w = jax.random.normal(k2, (d, v)) * 0.1
+    labels = jax.random.randint(k3, (n,), 0, v)
+    ref = cross_entropy_loss(h @ w, labels)
+    got = fused_linear_cross_entropy(h, w, labels, chunk_size=32)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    # gradients agree too (the checkpointed-scan backward is the point)
+    g_ref = jax.grad(lambda h, w: cross_entropy_loss(h @ w, labels), argnums=(0, 1))(h, w)
+    g_got = jax.grad(fused_linear_cross_entropy, argnums=(0, 1))(h, w, labels, chunk_size=32)
+    np.testing.assert_allclose(np.asarray(g_got[0]), np.asarray(g_ref[0]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_got[1]), np.asarray(g_ref[1]), atol=1e-6)
+
+
+def test_fused_cross_entropy_respects_mask():
+    from ddl25spring_tpu.ops.losses import (cross_entropy_loss,
+                                            fused_linear_cross_entropy)
+    k1, k2, k3 = jax.random.split(jax.random.key(1), 3)
+    h = jax.random.normal(k1, (20, 8))
+    w = jax.random.normal(k2, (8, 11))
+    labels = jax.random.randint(k3, (20,), 0, 11)
+    mask = (jnp.arange(20) < 13)
+    ref = cross_entropy_loss((h @ w)[:13], labels[:13])
+    got = fused_linear_cross_entropy(h, w, labels, mask=mask, chunk_size=7)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+
+def test_forward_loss_matches_forward_plus_loss():
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops import causal_lm_loss
+    cfg = config.LlamaConfig(vocab_size=64, dmodel=16, num_heads=2,
+                             n_layers=2, ctx_size=16)
+    params = llama.init_llama(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (3, cfg.ctx_size), 0, 64)
+    ref = causal_lm_loss(llama.forward(params, tokens, cfg), tokens)
+    got = llama.forward_loss(params, tokens, cfg)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    g_ref = jax.grad(lambda p: causal_lm_loss(llama.forward(p, tokens, cfg), tokens))(params)
+    g_got = jax.grad(lambda p: llama.forward_loss(p, tokens, cfg))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_got)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-6)

@@ -1,8 +1,9 @@
-"""Pallas flash attention vs the XLA einsum reference.
+"""Pallas flash attention vs the XLA reference.
 
-Runs in interpret mode on the CPU test mesh. Real-TPU Mosaic compilation is
-NOT covered here — compile and numerics on hardware were checked manually
-(max abs err ~2e-3 vs the XLA path, MXU bf16-pass accumulation).
+Most tests run in interpret mode on the CPU test mesh; real-TPU Mosaic
+compilation + differentiation is covered by the subprocess smoke test at the
+bottom of this file (test_flash_on_real_tpu_smoke), which is skipped
+automatically when no TPU is attached.
 """
 
 import jax

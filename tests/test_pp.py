@@ -53,19 +53,41 @@ def _assert_trees_close(a, b, atol):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol, rtol=0)
 
 
-@pytest.mark.parametrize("n_stages,n_microbatches", [(4, 1), (4, 4), (2, 4)])
-def test_pipeline_matches_single_device(devices, n_stages, n_microbatches):
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("n_stages,n_microbatches", [(4, 1), (4, 4), (2, 4), (4, 8)])
+def test_pipeline_matches_single_device(devices, n_stages, n_microbatches, schedule):
     params, tokens = _params_and_tokens()
     optimizer = optax.sgd(0.1)
     ref_loss, ref_params = _reference_step(params, tokens, optimizer, n_microbatches)
 
     mesh = make_mesh({"stage": n_stages}, devices=devices[:n_stages])
     state = pp.init_state(mesh, params, optimizer)
-    step = pp.make_pipeline_step(CFG, optimizer, mesh, n_microbatches)
+    step = pp.make_pipeline_step(CFG, optimizer, mesh, n_microbatches,
+                                 schedule=schedule)
     state, loss = step(state, pp.shard_batch(mesh, tokens))
 
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_trees_close(jax.device_get(state.params), jax.device_get(ref_params), 2e-5)
+
+
+@pytest.mark.parametrize("n_stages,n_microbatches", [(4, 4), (2, 8)])
+def test_1f1b_matches_gpipe_exactly(devices, n_stages, n_microbatches):
+    """The two schedules are the same math down to reduction order per
+    microbatch, so their losses/updates agree to fp32 tolerance."""
+    optimizer = optax.sgd(0.1)
+    mesh = make_mesh({"stage": n_stages}, devices=devices[:n_stages])
+    results = {}
+    for schedule in ("gpipe", "1f1b"):
+        # Fresh params per run: the jitted step donates its input state, and
+        # init_state's device_put may alias the caller's buffers.
+        params, tokens = _params_and_tokens()
+        state = pp.init_state(mesh, params, optimizer)
+        step = pp.make_pipeline_step(CFG, optimizer, mesh, n_microbatches,
+                                     schedule=schedule)
+        state, loss = step(state, pp.shard_batch(mesh, tokens))
+        results[schedule] = (float(loss), jax.device_get(state.params))
+    np.testing.assert_allclose(results["gpipe"][0], results["1f1b"][0], atol=1e-6)
+    _assert_trees_close(results["gpipe"][1], results["1f1b"][1], 1e-5)
 
 
 def test_dp_pp_matches_single_device(devices):
