@@ -66,15 +66,19 @@ def mnist_fl_setup(cfg: FLConfig, *, n_train: int = 60000, n_test: int = 10000
 
 
 def heart_vfl_setup(nr_clients: int, partitioner: str = "base", *,
-                    seed: int = 0, min_features: int = 2):
+                    seed: int = 0, min_features: int = 2,
+                    dedup: bool = False):
     """(xs_train, y_train, xs_test, y_test, names) vertically partitioned.
 
     ``partitioner``: "base" (the tutorial's 4-way fixed split becomes an even
     deal over base features), "even", or "min2" — hw2's two policies.
+    ``dedup``: duplicate-aware split (see tabular.train_test_split) — the
+    honest-generalization variant alongside the reference's leaky protocol.
     """
     X, y = tabular.load_heart()
     feats, names = tabular.preprocess(X)
-    x_tr, y_tr, x_te, y_te = tabular.train_test_split(feats, y, seed=seed)
+    x_tr, y_tr, x_te, y_te = tabular.train_test_split(feats, y, seed=seed,
+                                                      dedup=dedup)
     if partitioner == "even":
         parts = tabular.split_features_evenly(names, nr_clients, seed=seed)
     elif partitioner == "min2":

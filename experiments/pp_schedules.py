@@ -44,8 +44,8 @@ def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
     from ddl25spring_tpu.models import llama
     from ddl25spring_tpu.parallel import make_mesh, pp
 
-    cfg = LlamaConfig(vocab_size=512, dmodel=64, num_heads=4, n_layers=6,
-                      ctx_size=64)
+    cfg = LlamaConfig(vocab_size=512, dmodel=64, num_heads=4, n_layers=8,
+                      ctx_size=64)  # 8 layers: divisible by 2/4/8 stages
     devices = jax.devices()[:n_stages]
     mesh = make_mesh({"stage": n_stages}, devices=devices)
     optimizer = optax.sgd(0.1)

@@ -1,0 +1,86 @@
+"""Smoke tests for the parity-evidence experiment harness.
+
+These exercise the runners' plumbing (setup → server/trainer → ResultSink →
+parity report) at tiny scale; the committed full-scale results live under
+experiments/results/.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.config import FLConfig
+from ddl25spring_tpu.data import tabular
+
+
+def test_dedup_split_has_no_train_test_twins():
+    X, y = tabular.load_heart()
+    feats, _ = tabular.preprocess(X)
+    x_tr, y_tr, x_te, y_te = tabular.train_test_split(feats, y, seed=0,
+                                                      dedup=True)
+    train_rows = {tuple(r) + (int(t),) for r, t in zip(np.round(x_tr, 6), y_tr)}
+    leaks = sum(tuple(r) + (int(t),) in train_rows
+                for r, t in zip(np.round(x_te, 6), y_te))
+    assert leaks == 0
+    assert len(y_te) > 0 and len(y_tr) > 0
+    # the plain split on the REAL (duplicate-expanded) dataset DOES leak —
+    # that is the point of the dedup variant; the synthetic fallback draws
+    # unique random rows, so only assert this against real data
+    from experiments import common
+    if common.heart_provenance() == "heart-real":
+        x_tr2, y_tr2, x_te2, y_te2 = tabular.train_test_split(feats, y, seed=0)
+        train_rows2 = {tuple(r) + (int(t),)
+                       for r, t in zip(np.round(x_tr2, 6), y_tr2)}
+        leaks2 = sum(tuple(r) + (int(t),) in train_rows2
+                     for r, t in zip(np.round(x_te2, 6), y_te2))
+        assert leaks2 > 0
+
+
+def test_hw1_run_one_writes_provenance_rows(tmp_path):
+    from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.utils.tracing import ResultSink
+
+    from experiments import hw1_fl
+
+    sink = ResultSink(str(tmp_path / "out.csv"))
+    cfg = FLConfig(nr_clients=4, client_fraction=0.5, batch_size=20,
+                   rounds=2, seed=10)
+    acc = hw1_fl.run_one(FedAvgServer, cfg, sink, "mnist-synthetic",
+                         n_train=200, n_test=50)
+    assert 0.0 <= acc <= 1.0
+    df = sink.read_df()
+    assert len(df) == 2 and set(df["data"]) == {"mnist-synthetic"}
+    assert list(df["round"]) == [1, 2]
+
+
+def test_hw3_defense_hooks_resolve():
+    from experiments.hw3_defenses import _defense_hook
+
+    assert _defense_hook("none", 2) is None
+    for name, extra in (("krum", {}), ("multi_krum", {}),
+                        ("majority_sign", {}),
+                        ("bulyan", {"k": 4, "beta": 0.2}),
+                        ("sparse_fed", {"topk_fraction": 0.4})):
+        assert callable(_defense_hook(name, 2, **extra))
+    with pytest.raises(ValueError):
+        _defense_hook("unknown", 2)
+
+
+def test_parity_report_renders_from_committed_results():
+    from experiments import parity_report
+
+    text = parity_report.render()
+    assert "# PARITY" in text
+    assert "hw1" in text and "hw2" in text and "hw3" in text
+    # provenance discipline: the report explains the synthetic fallbacks
+    assert "synthetic" in text.lower()
+
+
+def test_provenance_labels():
+    from experiments import common
+
+    assert common.mnist_provenance() in ("mnist-real", "mnist-synthetic")
+    assert common.heart_provenance() in ("heart-real", "heart-synthetic")
+    assert common.tinystories_provenance() in (
+        "tinystories-real", "tinystories-synthetic")
