@@ -1,0 +1,37 @@
+"""Shared example plumbing: device/mesh selection for one-command runs."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def base_parser(**defaults) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force N virtual CPU devices (the reference's "
+                         "multi-node-without-a-cluster mode, homework_1_b1.sh)")
+    ap.add_argument("--iters", type=int, default=defaults.get("iters", 200))
+    ap.add_argument("--batch", type=int, default=defaults.get("batch", 3))
+    return ap
+
+
+def setup_devices(args) -> None:
+    """Must run before any jax device use."""
+    if args.cpu_devices:
+        import re
+        flags = os.environ.get("XLA_FLAGS", "")
+        # The explicit flag overrides any stale count already in XLA_FLAGS.
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.cpu_devices}").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def repo_on_path() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))

@@ -1,0 +1,75 @@
+"""FedAvg under Byzantine attack with a pluggable defense — one command.
+
+Reference: lab/tutorial_3/attacks_and_defenses.ipynb — 20% of clients
+replaced by attacker subclasses (cell 9), defenses plugged into the
+aggregation point (cells 34/43); hw3 setting lr=0.02, B=200, C=0.2, E=2,
+seed 42.
+
+    python examples/attacks_defenses.py --attack gradient_reversion --defense krum
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+ATTACKS = ("gradient_reversion", "partial_reversion", "untargeted_flip",
+           "targeted_flip", "backdoor", "none")
+DEFENSES = ("none", "krum", "multi_krum", "median", "trimmed_mean",
+            "majority_sign", "clipping", "bulyan", "sparse_fed")
+
+
+def main():
+    ap = base_parser()
+    ap.add_argument("--attack", choices=ATTACKS, default="gradient_reversion")
+    ap.add_argument("--defense", choices=DEFENSES, default="krum")
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--noniid", action="store_true")
+    ap.add_argument("--n-train", type=int, default=60000)
+    ap.add_argument("--n-test", type=int, default=10000)
+    args = ap.parse_args()
+    setup_devices(args)
+    import numpy as np
+
+    from ddl25spring_tpu.config import FLConfig
+    from ddl25spring_tpu.fl import FedAvgGradServer
+    from ddl25spring_tpu.fl import attacks as atk
+    from ddl25spring_tpu.metrics import backdoor_metrics
+    from ddl25spring_tpu.models import mnist_cnn
+    from experiments import common
+    from experiments.hw3_defenses import _defense_hook
+
+    cfg = FLConfig(nr_clients=100, client_fraction=0.2, batch_size=200,
+                   epochs=2, lr=0.02, rounds=args.rounds, iid=not args.noniid,
+                   seed=42)
+    params, data, xt, yt = common.mnist_fl_setup(
+        cfg, n_train=args.n_train, n_test=args.n_test)
+
+    attack = {"gradient_reversion": atk.GradientReversion(),
+              "partial_reversion": atk.PartialGradientReversion(),
+              "untargeted_flip": atk.UntargetedLabelFlip(),
+              "targeted_flip": atk.TargetedLabelFlip(),
+              "backdoor": atk.PatternBackdoor(),
+              "none": None}[args.attack]
+    adversary = None
+    if attack is not None:
+        adversary = (atk.injection_mask(cfg.nr_clients, 0.2, cfg.seed), attack)
+
+    n_mal = int(0.2 * cfg.clients_per_round)
+    defense = _defense_hook(args.defense, n_mal, k=10, beta=0.2,
+                            topk_fraction=0.4)
+
+    server = FedAvgGradServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                              adversary=adversary, defense=defense)
+    result = server.run(cfg.rounds)
+    print(result.as_df().to_string(index=False))
+    if isinstance(attack, atk.PatternBackdoor):
+        logits_c = mnist_cnn.apply(server.params, xt)
+        logits_t = mnist_cnn.apply(server.params, attack.trigger_test_set(xt))
+        acc, asr = backdoor_metrics(np.asarray(logits_c.argmax(-1)), np.asarray(yt),
+                                    np.asarray(logits_t.argmax(-1)),
+                                    attack.backdoor_label)
+        print(f"clean acc {acc:.4f}  backdoor ASR {asr:.4f}")
+
+
+if __name__ == "__main__":
+    main()

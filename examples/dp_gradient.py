@@ -1,0 +1,38 @@
+"""DP gradient-aggregation training — the reference's intro_DP_GA collapsed
+into one SPMD program.
+
+Reference: lab/tutorial_1b/DP/gradient_aggr/intro_DP_GA.py (+ run.sh spawning
+3 gloo ranks): per-iter flatten → all_reduce(SUM) → unflatten → ÷world_size.
+Here: ``lax.pmean(grads, "data")`` inside a jitted shard_map step over every
+available device; the stream offset per shard reproduces skip=rank*5000.
+
+    python examples/dp_gradient.py --cpu-devices 3 --iters 200
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    args = base_parser(iters=200, batch=3).parse_args()
+    setup_devices(args)
+    import jax
+
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.train.llm import train_llm_dp
+
+    n = len(jax.devices())
+    report = train_llm_dp(
+        LlamaConfig(dtype="bfloat16"),
+        TrainConfig(iters=args.iters, batch_size=args.batch, data=n),
+        mesh=make_mesh({"data": n}),
+        aggregation="gradient",
+        log_every=max(1, args.iters // 20))
+    print(f"final loss {report.losses[-1]:.4f}  "
+          f"{report.tokens_per_sec:.0f} tok/s over {n} device(s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""DP weight-aggregation training — intro_DP_WA's *intended* semantics.
+
+Reference: lab/tutorial_1b/DP/weight_aggr/intro_DP_WA.py — step first, then
+allreduce the weights (the script's missing write-back is a recorded bug we
+do not reproduce; see parallel/dp.py).
+
+    python examples/dp_weight.py --cpu-devices 3 --iters 200
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    args = base_parser(iters=200, batch=3).parse_args()
+    setup_devices(args)
+    import jax
+
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.train.llm import train_llm_dp
+
+    n = len(jax.devices())
+    report = train_llm_dp(
+        LlamaConfig(dtype="bfloat16"),
+        TrainConfig(iters=args.iters, batch_size=args.batch, data=n),
+        mesh=make_mesh({"data": n}),
+        aggregation="weight",
+        log_every=max(1, args.iters // 20))
+    print(f"final loss {report.losses[-1]:.4f}  "
+          f"{report.tokens_per_sec:.0f} tok/s over {n} device(s)")
+
+
+if __name__ == "__main__":
+    main()

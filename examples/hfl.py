@@ -1,0 +1,53 @@
+"""Horizontal FL on MNIST — FedSGD / FedAvg / centralized, one command.
+
+Reference: lab/tutorial_1a/hfl_complete.py `__main__` (and the homework-1
+defaults N=100, C=0.1, E=1, B=100, lr=0.01, 10 rounds, IID, seed 10 —
+lab/homework-1.ipynb cell 5). Clients are a vmapped axis of one jitted
+round program, not sequential objects; prints the RunResult dataframe.
+
+    python examples/hfl.py --algo fedavg --rounds 10
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser()
+    ap.add_argument("--algo", choices=("fedsgd", "fedsgd-w", "fedavg",
+                                       "centralized"), default="fedavg")
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--clients", type=int, default=100)
+    ap.add_argument("--fraction", type=float, default=0.1)
+    ap.add_argument("--noniid", action="store_true")
+    ap.add_argument("--n-train", type=int, default=60000)
+    ap.add_argument("--n-test", type=int, default=10000)
+    args = ap.parse_args()
+    setup_devices(args)
+    import jax
+
+    from ddl25spring_tpu.config import FLConfig
+    from ddl25spring_tpu.fl import (CentralizedServer, FedAvgServer,
+                                    FedSgdGradientServer, FedSgdWeightServer)
+    from ddl25spring_tpu.models import mnist_cnn
+    from experiments import common
+
+    cfg = FLConfig(nr_clients=args.clients, client_fraction=args.fraction,
+                   rounds=args.rounds, iid=not args.noniid)
+    if args.algo == "centralized":
+        x, y, xt, yt = common.mnist_arrays(args.n_train, args.n_test)
+        server = CentralizedServer(mnist_cnn.init(jax.random.key(0)),
+                                   mnist_cnn.apply, x, y, xt, yt, cfg)
+    else:
+        cls = {"fedsgd": FedSgdGradientServer, "fedsgd-w": FedSgdWeightServer,
+               "fedavg": FedAvgServer}[args.algo]
+        params, data, xt, yt = common.mnist_fl_setup(
+            cfg, n_train=args.n_train, n_test=args.n_test)
+        server = cls(params, mnist_cnn.apply, data, xt, yt, cfg)
+    result = server.run(cfg.rounds)
+    print(result.as_df().to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

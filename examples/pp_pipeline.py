@@ -1,0 +1,53 @@
+"""Pipeline-parallel training — naive staged, GPipe, or true 1F1B.
+
+Reference: lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py (naive 3-stage; the file
+is named 1F1B but is not one) and lab/tutorial_1a/homework_1_b1.py
+(microbatched GPipe over isend/irecv). Here: the schedule is a lax.scan, the
+stage hop is one lax.ppermute over the ICI ring, and ``--schedule 1f1b``
+runs an actual interleaved 1F1B (parallel/pp.py).
+
+    python examples/pp_pipeline.py --cpu-devices 3 --microbatches 3 --schedule gpipe
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser(iters=100, batch=3)
+    ap.add_argument("--microbatches", type=int, default=3)
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe")
+    args = ap.parse_args()
+    setup_devices(args)
+    import jax
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.data.tokens import TokenStream
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import make_mesh, pp
+    from ddl25spring_tpu.tokenizers import load_tokenizer
+
+    tok = load_tokenizer()
+    cfg = LlamaConfig(dtype="bfloat16", vocab_size=tok.vocab_size)
+    n_stages = len(jax.devices())
+    assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
+    mesh = make_mesh({"stage": n_stages})
+    opt = optax.adam(8e-4)
+    state = pp.init_state(mesh, llama.init_llama(jax.random.key(0), cfg), opt)
+    step = pp.make_pipeline_step(cfg, opt, mesh, args.microbatches,
+                                 schedule=args.schedule)
+    batch_rows = args.batch * args.microbatches
+    stream = TokenStream(tok, batch_rows, cfg.ctx_size)
+    it = iter(stream)
+    for i in range(args.iters):
+        state, loss = step(state, pp.shard_batch(mesh, next(it)))
+        if i % max(1, args.iters // 20) == 0:
+            print(f"iter {i}: loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f} "
+          f"({args.schedule}, {n_stages} stages x {args.microbatches} mbs)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Vertical FL / split learning on heart.csv — one command.
+
+Reference: lab/tutorial_2b/vfl.py `__main__` — 4 parties' bottom MLPs feed a
+server top model through the activation-concat cut layer; 300 epochs, B=64.
+
+    python examples/vfl.py --clients 4 --epochs 300
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser()
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=300)
+    ap.add_argument("--partitioner", choices=("base", "even", "min2"),
+                    default="base",
+                    help="'base' = the tutorial's fixed feature deal "
+                         "(vfl.py:105-157); 'even'/'min2' = hw2's seeded "
+                         "policies")
+    ap.add_argument("--dedup", action="store_true",
+                    help="duplicate-aware train/test split (honest "
+                         "generalization; see data/tabular.py)")
+    args = ap.parse_args()
+    setup_devices(args)
+    from ddl25spring_tpu.config import VFLConfig
+    from ddl25spring_tpu.train.vfl import train_vfl
+    from experiments import common
+
+    xs_tr, y_tr, xs_te, y_te, _ = common.heart_vfl_setup(
+        args.clients, args.partitioner, seed=0, dedup=args.dedup)
+    cfg = VFLConfig(nr_clients=args.clients, epochs=args.epochs)
+    _, rep = train_vfl(xs_tr, y_tr, xs_te, y_te, cfg,
+                       log_every=max(1, args.epochs // 10))
+    print(f"test accuracy {rep.test_accuracy:.4f} "
+          f"({args.clients} parties, {args.partitioner}"
+          f"{', dedup split' if args.dedup else ''})")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,19 +50,24 @@ def tinystories_provenance() -> str:
     return "tinystories-synthetic"
 
 
+def mnist_arrays(n_train: int = 60000, n_test: int = 10000):
+    """(x, y, test_x, test_y) normalized with the reference's constants."""
+    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=n_train, n_test=n_test,
+                                            seed=0)
+    return (mnist.normalize(x_raw), y.astype(np.int32),
+            mnist.normalize(xt_raw), yt.astype(np.int32))
+
+
 def mnist_fl_setup(cfg: FLConfig, *, n_train: int = 60000, n_test: int = 10000
                    ) -> Tuple[dict, FederatedDataset, np.ndarray, np.ndarray]:
     """(init_params, federated train data, test_x, test_y) at the reference's
     MNIST setup: normalize with (0.1307, 0.3081), split IID or the
     sort-into-2N-shards non-IID scheme, stack on the client axis."""
-    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=n_train, n_test=n_test,
-                                            seed=0)
-    x = mnist.normalize(x_raw)
-    xt = mnist.normalize(xt_raw)
+    x, y, xt, yt = mnist_arrays(n_train, n_test)
     subsets = mnist.split(y, cfg.nr_clients, iid=cfg.iid, seed=cfg.seed)
-    data = federate(x, y.astype(np.int32), subsets)
+    data = federate(x, y, subsets)
     params = mnist_cnn.init(jax.random.key(0))
-    return params, data, xt, yt.astype(np.int32)
+    return params, data, xt, yt
 
 
 def heart_vfl_setup(nr_clients: int, partitioner: str = "base", *,

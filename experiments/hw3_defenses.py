@@ -48,6 +48,13 @@ def _defense_hook(name: str, n_mal: int, **kw):
                                      k=kw.get("k", 10))
     if name == "majority_sign":
         return dfn.coordinate_defense(dfn.majority_sign)
+    if name == "median":
+        return dfn.coordinate_defense(dfn.coordinate_median)
+    if name == "trimmed_mean":
+        return dfn.coordinate_defense(dfn.trimmed_mean,
+                                      beta=kw.get("beta", 0.2))
+    if name == "clipping":
+        return dfn.coordinate_defense(dfn.norm_clipping)
     if name == "bulyan":
         return dfn.coordinate_defense(dfn.bulyan, n_malicious=n_mal,
                                       k=kw["k"], beta=kw["beta"])
