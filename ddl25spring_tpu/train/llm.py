@@ -43,11 +43,20 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  aggregation: str = "gradient",
                  log_every: int = 100,
                  log_fn: Callable[[str], None] = print,
-                 warmup_steps_excluded: int = 2) -> LLMTrainReport:
+                 warmup_steps_excluded: int = 2,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1000) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
     ``aggregation``: "gradient" (allreduce grads — intro_DP_GA) or "weight"
     (allreduce weights post-step — intro_DP_WA's intended semantics).
+
+    ``checkpoint_dir`` enables orbax checkpoint/resume (the persistence layer
+    the reference lacks, SURVEY.md §5.4): the latest step in the directory is
+    restored into the mesh layout before training, a checkpoint is written
+    every ``checkpoint_every`` steps and at the end, and already-completed
+    iterations are skipped — re-running the same call after an interruption
+    continues where it stopped.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
@@ -58,6 +67,21 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     params = llama.init_llama(jax.random.key(train_cfg.seed), model_cfg)
     optimizer = optax.adam(train_cfg.lr)
     state = dp.replicate(mesh, dp.init_state(params, optimizer))
+
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from ..checkpoint import Checkpointer
+        ckpt = Checkpointer(checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            start_step = int(ckpt.latest_step())
+            log_fn(f"resumed from step {start_step}")
+        if start_step >= train_cfg.iters:
+            log_fn(f"checkpoint already at step {start_step} >= "
+                   f"iters {train_cfg.iters}; nothing to train")
+            ckpt.close()
+            return LLMTrainReport()
 
     def loss_fn(p, batch):
         # Fused head+CE: never materializes the [B, T, V] logits (the step's
@@ -74,24 +98,34 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                               shard_skip=5000, seed=train_cfg.seed)
 
     report = LLMTrainReport()
+    last_saved = -1
     tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
     t_start = None
     device_losses = []  # keep losses on device; a float() per step would
     #                     serialize dispatch and deflate throughput
     for it in range(train_cfg.iters):
         host_batch = next(batches).reshape(n_data * train_cfg.batch_size, train_cfg.seq_len)
+        if it < start_step:
+            continue  # resume: replay the stream so data order is preserved
         batch = dp.shard_batch(mesh, host_batch)
         state, loss = step_fn(state, batch)
-        if it + 1 == warmup_steps_excluded:
+        if it + 1 == start_step + warmup_steps_excluded:
             float(loss)  # hard sync before starting the timer
             t_start = time.perf_counter()
         device_losses.append(loss)
         if log_every and it % log_every == 0:
             log_fn(f"iter {it}: loss {float(loss):.4f}")
+        if ckpt is not None and (it + 1) % checkpoint_every == 0:
+            ckpt.save(it + 1, state)
+            last_saved = it + 1
+    if ckpt is not None:
+        if train_cfg.iters != last_saved:
+            ckpt.save(train_cfg.iters, state, force=True)
+        ckpt.close()
     report.losses = [float(l) for l in device_losses]  # syncs the full chain
-    report.steps = train_cfg.iters
-    if t_start is not None and train_cfg.iters > warmup_steps_excluded:
+    report.steps = train_cfg.iters - start_step
+    if t_start is not None and train_cfg.iters - start_step > warmup_steps_excluded:
         report.wall_time = time.perf_counter() - t_start
-        timed_steps = train_cfg.iters - warmup_steps_excluded
+        timed_steps = train_cfg.iters - start_step - warmup_steps_excluded
         report.tokens_per_sec = tokens_per_step * timed_steps / report.wall_time
     return report

@@ -8,12 +8,17 @@ import sys
 
 
 def base_parser(**defaults) -> argparse.ArgumentParser:
+    """Common flags. --iters/--batch are only added for the examples that
+    consume them (those passing defaults), so FL-style examples don't accept
+    flags they would silently ignore."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force N virtual CPU devices (the reference's "
                          "multi-node-without-a-cluster mode, homework_1_b1.sh)")
-    ap.add_argument("--iters", type=int, default=defaults.get("iters", 200))
-    ap.add_argument("--batch", type=int, default=defaults.get("batch", 3))
+    if "iters" in defaults:
+        ap.add_argument("--iters", type=int, default=defaults["iters"])
+    if "batch" in defaults:
+        ap.add_argument("--batch", type=int, default=defaults["batch"])
     return ap
 
 

@@ -36,11 +36,10 @@ def main():
     from ddl25spring_tpu.metrics import backdoor_metrics
     from ddl25spring_tpu.models import mnist_cnn
     from experiments import common
-    from experiments.hw3_defenses import _defense_hook
+    from experiments.hw3_defenses import (HW3, MALICIOUS_FRACTION,
+                                          _defense_hook)
 
-    cfg = FLConfig(nr_clients=100, client_fraction=0.2, batch_size=200,
-                   epochs=2, lr=0.02, rounds=args.rounds, iid=not args.noniid,
-                   seed=42)
+    cfg = FLConfig(rounds=args.rounds, iid=not args.noniid, **HW3)
     params, data, xt, yt = common.mnist_fl_setup(
         cfg, n_train=args.n_train, n_test=args.n_test)
 
@@ -52,9 +51,10 @@ def main():
               "none": None}[args.attack]
     adversary = None
     if attack is not None:
-        adversary = (atk.injection_mask(cfg.nr_clients, 0.2, cfg.seed), attack)
+        adversary = (atk.injection_mask(cfg.nr_clients, MALICIOUS_FRACTION,
+                                        cfg.seed), attack)
 
-    n_mal = int(0.2 * cfg.clients_per_round)
+    n_mal = int(MALICIOUS_FRACTION * cfg.clients_per_round)
     defense = _defense_hook(args.defense, n_mal, k=10, beta=0.2,
                             topk_fraction=0.4)
 

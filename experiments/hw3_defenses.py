@@ -79,6 +79,7 @@ def run_one(defense: str, iid: bool, sink, provenance: str, *, rounds: int,
     result = server.run(cfg.rounds)
     df = result.as_df()
     df["data"] = provenance
+    df["n_train"] = n_train
     df["defense"] = defense
     df["iid"] = iid
     df["attack"] = "gradient_reversion_20pct"
@@ -89,9 +90,13 @@ def run_one(defense: str, iid: bool, sink, provenance: str, *, rounds: int,
     return result.test_accuracy[-1]
 
 
-def main(quick: bool = False) -> Dict[str, float]:
+def main(quick: bool = False, n_train: int = 60000, n_test: int = 10000
+         ) -> Dict[str, float]:
+    """See hw1_fl.main on n_train/n_test: the committed CPU run uses
+    6000/1500 (synthetic MNIST; protocol knobs exact)."""
     provenance = common.mnist_provenance()
-    n_train, n_test = (2000, 500) if quick else (60000, 10000)
+    if quick:
+        n_train, n_test = 2000, 500
     rounds = 2 if quick else 10
     finals: Dict[str, float] = {}
 
