@@ -184,14 +184,17 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         # Averaged 1/n-th of the gradient lands on its owner shard.
         g_mine = lax.psum_scatter(flat_g, "data", scatter_dimension=0,
                                   tiled=True) / n
-        flat_p, unravel = pt.flatten(params)
-        flat_p = jnp.pad(flat_p.astype(jnp.float32), (0, pad))
+        raw_flat, unravel = pt.flatten(params)
+        flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
         shard = lax.axis_index("data")
         p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
         updates, opt_state = optimizer.update(g_mine, state.opt_state, p_mine)
         p_new = optax.apply_updates(p_mine, updates)
         flat_new = lax.all_gather(p_new, "data", tiled=True)[:total]
-        new_params = unravel(flat_new)
+        # Cast back before unravel: for single-dtype trees ravel_pytree's
+        # unravel is dtype-polymorphic and would silently rebuild non-fp32
+        # params (e.g. param_dtype="bfloat16") as fp32.
+        new_params = unravel(flat_new.astype(raw_flat.dtype))
         loss = lax.pmean(loss, "data")
         return TrainState(new_params, opt_state, state.step + 1), loss
 

@@ -18,6 +18,9 @@ def main():
     ap.add_argument("--microbatches", type=int, default=3)
     ap.add_argument("--pipelines", type=int, default=2)
     ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (adds a 'model' mesh axis; "
+                         "Megatron-sharded block weights, parallel/tp.py)")
     args = ap.parse_args()
     setup_devices(args)
     import jax
@@ -32,12 +35,12 @@ def main():
 
     n_dev = len(jax.devices())
     data = args.pipelines
-    assert n_dev % data == 0, (n_dev, data)
-    n_stages = n_dev // data
+    assert n_dev % (data * args.tp) == 0, (n_dev, data, args.tp)
+    n_stages = n_dev // (data * args.tp)
     tok = load_tokenizer()
     cfg = LlamaConfig(dtype="bfloat16", vocab_size=tok.vocab_size)
     assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
-    mesh = make_mesh({"data": data, "stage": n_stages})
+    mesh = make_mesh({"data": data, "stage": n_stages, "model": args.tp})
     opt = optax.adam(8e-4)
     state = pp.init_state(mesh, llama.init_llama(jax.random.key(0), cfg), opt)
     step = pp.make_pipeline_step(cfg, opt, mesh, args.microbatches,

@@ -1,0 +1,58 @@
+"""Sequence-parallel (ring attention) training — long context over a mesh.
+
+No reference counterpart: the course stack fixes seq_len=256 on one device
+(SURVEY.md §5.7). This is the framework's first-class long-context mode:
+the sequence is a mesh axis, K/V shards rotate around the ICI ring via
+lax.ppermute with online-softmax accumulation (parallel/sp.py), so context
+scales linearly with ring size.
+
+    python examples/long_context.py --cpu-devices 4 --seq 1024 --ring 4
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser(iters=50, batch=2)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--ring", type=int, default=0,
+                    help="sequence-axis size (default: all devices)")
+    args = ap.parse_args()
+    setup_devices(args)
+    import jax
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.data.tokens import TokenStream
+    from ddl25spring_tpu.parallel import make_mesh, sp
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.tokenizers import load_tokenizer
+
+    n_dev = len(jax.devices())
+    ring = args.ring or n_dev
+    assert 0 < ring <= n_dev and n_dev % ring == 0, \
+        f"--ring {ring} must divide device count {n_dev}"
+    assert args.seq % ring == 0, \
+        f"--seq {args.seq} must divide over the ring of {ring}"
+    data = n_dev // ring
+    tok = load_tokenizer()
+    cfg = LlamaConfig(dtype="bfloat16", vocab_size=tok.vocab_size,
+                      ctx_size=args.seq)
+    mesh = make_mesh({"data": data, "seq": ring})
+    opt = optax.adam(8e-4)
+    state = sp.init_state(mesh, llama.init_llama(jax.random.key(0), cfg), opt)
+    step = sp.make_sp_train_step(cfg, opt, mesh)
+    stream = TokenStream(tok, data * args.batch, args.seq)
+    it = iter(stream)
+    for i in range(args.iters):
+        state, loss = step(state, sp.shard_batch(mesh, next(it)))
+        if i % max(1, args.iters // 10) == 0:
+            print(f"iter {i}: loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f} "
+          f"(seq {args.seq} over ring of {ring}, data={data})")
+
+
+if __name__ == "__main__":
+    main()

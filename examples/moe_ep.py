@@ -1,0 +1,57 @@
+"""Mixture-of-Experts training with expert parallelism.
+
+No reference counterpart (SURVEY.md §2.10 marks EP absent). Every block's
+SwiGLU MLP becomes a top-k routed expert bank sharded over an ``expert``
+mesh axis; the router stays replicated (models/moe.py, parallel/ep.py).
+
+    python examples/moe_ep.py --cpu-devices 4 --experts 4
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser(iters=50, batch=2)
+    ap.add_argument("--experts", type=int, default=4)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=0,
+                    help="expert-axis size (default: all devices)")
+    args = ap.parse_args()
+    setup_devices(args)
+    import jax
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig, MoEConfig
+    from ddl25spring_tpu.data.tokens import TokenStream
+    from ddl25spring_tpu.models import moe
+    from ddl25spring_tpu.parallel import ep, make_mesh
+    from ddl25spring_tpu.tokenizers import load_tokenizer
+
+    n_dev = len(jax.devices())
+    n_ep = args.ep or min(n_dev, args.experts)
+    assert n_dev % n_ep == 0, f"--ep {n_ep} must divide device count {n_dev}"
+    assert args.experts % n_ep == 0, \
+        f"--experts {args.experts} must divide over --ep {n_ep} shards"
+    data = n_dev // n_ep
+    tok = load_tokenizer()
+    cfg = MoEConfig(base=LlamaConfig(dtype="bfloat16",
+                                     vocab_size=tok.vocab_size),
+                    n_experts=args.experts, top_k=args.top_k)
+    mesh = make_mesh({"data": data, "expert": n_ep})
+    opt = optax.adam(8e-4)
+    state = ep.init_state(mesh, moe.init_moe_llama(jax.random.key(0), cfg), opt)
+    step = ep.make_ep_train_step(cfg, opt, mesh)
+    stream = TokenStream(tok, data * args.batch, cfg.base.ctx_size)
+    it = iter(stream)
+    for i in range(args.iters):
+        state, loss = step(state, ep.shard_batch(mesh, next(it)))
+        if i % max(1, args.iters // 10) == 0:
+            print(f"iter {i}: loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f} "
+          f"({args.experts} experts top-{args.top_k} over {n_ep} shards)")
+
+
+if __name__ == "__main__":
+    main()
