@@ -23,10 +23,10 @@ import time
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from ddl25spring_tpu.config import LlamaConfig
 from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.ops.adam import fused_adam
 from ddl25spring_tpu.parallel import dp, make_mesh
 
 TORCH_CPU_BASELINE_TOKENS_PER_SEC = 520.0
@@ -65,7 +65,10 @@ def time_batch(mesh, cfg, batch_size: int) -> float:
     """Tokens/sec for the DP train step at the given per-chip batch size."""
     n_dev = mesh.devices.size
     params = llama.init_llama(jax.random.key(0), cfg)
-    opt = optax.adam(8e-4)
+    # Single-pass fused Adam (ops/adam.py): same update as optax.adam(8e-4)
+    # (asserted ≤1e-6 in tests/test_core.py) with fewer HBM round trips over
+    # the 24 M-param state — the optimizer leg is memory-bound.
+    opt = fused_adam(8e-4)
     state = dp.replicate(mesh, dp.init_state(params, opt))
 
     def loss_fn(p, batch):

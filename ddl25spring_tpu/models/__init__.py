@@ -1,1 +1,1 @@
-from . import llama, mnist_cnn, tabular, vae, vfl_nets  # noqa: F401
+from . import generate, llama, mnist_cnn, tabular, vae, vfl_nets  # noqa: F401

@@ -1,0 +1,190 @@
+"""Autoregressive decoding for the tiny-Llama: KV cache + sampling.
+
+Parity-plus: the reference's training stack (simplellm surface, SURVEY.md
+§2.9) never decodes — but a framework a reference user can *switch to* needs
+inference. TPU-native shape of the problem:
+
+- The KV cache is a pair of static-shape ``[L, B, max_len, H, Dh]`` arrays
+  (stacked-layer layout, matching the model's scanned ``[L, ...]`` blocks).
+  Static shapes mean one compile for prefill and one for the decode step —
+  no per-length recompilation; position is a traced scalar.
+- The whole generation loop is a single ``lax.scan`` over decode steps —
+  one compiled program per (batch, prompt_len, max_new) shape, sampling
+  included; nothing returns to Python between tokens.
+- Cache updates are ``lax.dynamic_update_slice`` writes; with the step jitted
+  and the cache donated, XLA performs them in place.
+- Decode attention masks by absolute position (``kpos <= pos``), so the
+  cache's unwritten tail is unread garbage, not a correctness hazard.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import LlamaConfig
+from .. import nn
+from . import llama
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    """Zeroed KV cache: {"k","v"} each [L, B, max_len, H, Dh] in the compute
+    dtype. ``max_len`` bounds prompt + generated tokens."""
+    dt = jnp.dtype(cfg.dtype)
+    shape = (cfg.n_layers, batch, max_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _attend_cached(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
+                   q_positions: jnp.ndarray) -> jnp.ndarray:
+    """Attention of q [B, Tq, H, Dh] over the full cache [B, Tmax, H, Dh],
+    masked to ``kpos <= q_position`` per query row. fp32 softmax, heads
+    folded into batch (the same layout as llama._xla_attention)."""
+    b, tq, h, dh = q.shape
+    tmax = ck.shape[1]
+    scale = 1.0 / math.sqrt(dh)
+    qm = q.transpose(0, 2, 1, 3).reshape(b * h, tq, dh)
+    km = ck.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh)
+    vm = cv.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh)
+    scores = lax.dot_general(qm, km, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32) * scale
+    mask = q_positions[:, None] >= jnp.arange(tmax)[None, :]   # [Tq, Tmax]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = lax.dot_general(probs, vm, (((2,), (1,)), ((0,), (0,))))
+    return out.reshape(b, h, tq, dh).transpose(0, 2, 1, 3)
+
+
+def _fuse_blocks(blocks: dict) -> dict:
+    """Pre-concatenate each layer's QKV and gate/up weights (leading [L] axis
+    preserved). Training fuses these per call — fine there, the concat is
+    noise next to a [B·T, D] matmul — but the decode loop runs matVECs, which
+    are weight-bandwidth-bound: a per-token concat would read and re-write
+    every weight byte it is about to stream, doubling traffic. Fusing once
+    per generate() call keeps the hot loop at one read per weight byte."""
+    return {
+        "attn_norm": blocks["attn_norm"],
+        "mlp_norm": blocks["mlp_norm"],
+        "w_qkv": jnp.concatenate([blocks["wq"], blocks["wk"], blocks["wv"]],
+                                 axis=-1),
+        "wo": blocks["wo"],
+        "w_gu": jnp.concatenate([blocks["w_gate"], blocks["w_up"]], axis=-1),
+        "w_down": blocks["w_down"],
+    }
+
+
+def _block_with_cache(block: dict, ck: jnp.ndarray, cv: jnp.ndarray,
+                      x: jnp.ndarray, positions: jnp.ndarray, start: jnp.ndarray,
+                      cfg: LlamaConfig) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One pre-fused block over x [B, T, D] at absolute ``positions`` [T],
+    writing this call's K/V into the cache at offset ``start`` and attending
+    over the whole cache. Serves both prefill (T = prompt length, start = 0)
+    and decode (T = 1, start = pos). Same math as llama.block_apply —
+    asserted against llama.forward position-by-position in
+    tests/test_generate.py."""
+    b, t, d = x.shape
+    dh = cfg.head_dim
+    xn = nn.rmsnorm(block["attn_norm"], x, eps=cfg.norm_eps)
+    qkv = xn @ block["w_qkv"].astype(x.dtype)
+    dl = qkv.shape[-1] // 3
+    h_local = dl // dh
+    q = qkv[..., :dl].reshape(b, t, h_local, dh)
+    k = qkv[..., dl:2 * dl].reshape(b, t, h_local, dh)
+    v = qkv[..., 2 * dl:].reshape(b, t, h_local, dh)
+    cos, sin = llama.rope_angles(positions, dh, cfg.rope_theta)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)          # cached K is stored post-RoPE
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, start, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, start, 0, 0))
+    out = _attend_cached(q, ck, cv, positions)
+    x = x + out.reshape(b, t, h_local * dh) @ block["wo"].astype(x.dtype)
+    xn = nn.rmsnorm(block["mlp_norm"], x, eps=cfg.norm_eps)
+    gu = xn @ block["w_gu"].astype(x.dtype)
+    f = gu.shape[-1] // 2
+    x = x + (jax.nn.silu(gu[..., :f]) * gu[..., f:]) @ block["w_down"].astype(x.dtype)
+    return x, ck, cv
+
+
+def _forward_fused(params: dict, fused_blocks: dict, tokens: jnp.ndarray,
+                   cache: dict, start, cfg: LlamaConfig
+                   ) -> Tuple[jnp.ndarray, dict]:
+    """Body of forward_cached, taking blocks already through _fuse_blocks."""
+    t = tokens.shape[1]
+    start = jnp.asarray(start, jnp.int32)
+    positions = start + jnp.arange(t)
+    h = llama.embed(params, tokens, cfg)
+
+    def body(carry, layer):
+        block, ck, cv = layer
+        out, ck, cv = _block_with_cache(block, ck, cv, carry, positions,
+                                        start, cfg)
+        return out, (ck, cv)
+
+    h, (ck, cv) = lax.scan(body, h, (fused_blocks, cache["k"], cache["v"]))
+    logits = llama.head(params, h[:, -1:, :], cfg)[:, 0, :]
+    return logits, {"k": ck, "v": cv}
+
+
+def forward_cached(params: dict, tokens: jnp.ndarray, cache: dict,
+                   start, cfg: LlamaConfig
+                   ) -> Tuple[jnp.ndarray, dict]:
+    """tokens [B, T] at absolute positions start..start+T → (logits of the
+    LAST position [B, V] fp32, updated cache). One lax.scan over the stacked
+    blocks, threading each layer's cache slice through the scanned axis."""
+    return _forward_fused(params, _fuse_blocks(params["blocks"]), tokens,
+                          cache, start, cfg)
+
+
+def _sample(key, logits: jnp.ndarray, temperature: float,
+            top_k: Optional[int]) -> jnp.ndarray:
+    """logits [B, V] → token ids [B]. temperature 0 = greedy (argmax)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]    # [B, 1]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "top_k", "max_len"))
+def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
+             max_new_tokens: int, *, key: Optional[jax.Array] = None,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             max_len: Optional[int] = None) -> jnp.ndarray:
+    """prompt [B, Tp] → generated ids [B, max_new_tokens].
+
+    One compiled program: prefill over the prompt, then a lax.scan of
+    single-token decode steps with in-place cache writes. Greedy by default;
+    ``temperature``/``top_k`` enable sampling (``key`` required then).
+    """
+    b, tp = prompt.shape
+    if max_len is None:
+        max_len = tp + max_new_tokens
+    assert max_len >= tp + max_new_tokens, (max_len, tp, max_new_tokens)
+    if key is None:
+        assert temperature == 0.0, "sampling (temperature>0) requires a key"
+        key = jax.random.PRNGKey(0)   # unused by greedy argmax
+    cache = init_cache(cfg, b, max_len)
+    fused = _fuse_blocks(params["blocks"])   # once, hoisted out of the scan
+    logits, cache = _forward_fused(params, fused, prompt, cache, 0, cfg)
+    key, sub = jax.random.split(key)
+    first = _sample(sub, logits, temperature, top_k)
+
+    def step(carry, _):
+        cache, tok, pos, key = carry
+        logits, cache = _forward_fused(params, fused, tok[:, None], cache,
+                                       pos, cfg)
+        key, sub = jax.random.split(key)
+        nxt = _sample(sub, logits, temperature, top_k)
+        return (cache, nxt, pos + 1, key), nxt
+
+    carry = (cache, first, jnp.asarray(tp, jnp.int32), key)
+    _, rest = lax.scan(step, carry, None, length=max_new_tokens - 1)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

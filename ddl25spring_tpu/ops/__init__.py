@@ -1,3 +1,4 @@
+from .adam import fused_adam  # noqa: F401
 from .losses import causal_lm_loss, cross_entropy_loss  # noqa: F401
 
 # NOTE: the flash-attention kernel is deliberately NOT re-exported here —

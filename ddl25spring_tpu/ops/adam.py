@@ -1,0 +1,68 @@
+"""Single-pass fused Adam — an optax-compatible GradientTransformation.
+
+Why: ``optax.adam`` composes scale_by_adam → scale(-lr), each stage a
+separate tree_map producing materialized intermediates (updated moments,
+bias-corrected copies, scaled updates). On a memory-bound optimizer step
+that is several extra HBM round trips over the full parameter footprint.
+Here the whole update rule is one jnp expression per leaf —
+
+    m ← β1·m + (1−β1)·g
+    v ← β2·v + (1−β2)·g²
+    u = −lr · (m/(1−β1^t)) / (√(v/(1−β2^t)) + ε)
+
+— so XLA fuses it into a single read of (g, m, v) and a single write of
+(u, m, v) per leaf. Semantics match ``optax.adam(lr, b1, b2, eps)`` bitwise
+up to float re-association (asserted ≤1e-6 in tests/test_core.py).
+
+Drop-in: ``fused_adam(8e-4)`` anywhere an ``optax.GradientTransformation``
+is accepted (dp/pp/ep steps, train.llm, bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray   # [] int32
+    mu: optax.Params
+    nu: optax.Params
+
+
+def fused_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+               eps: float = 1e-8) -> optax.GradientTransformation:
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p)
+        return FusedAdamState(jnp.zeros((), jnp.int32),
+                              jax.tree.map(zeros, params),
+                              jax.tree.map(zeros, params))
+
+    def update_fn(grads, state, params=None):
+        del params
+        count = state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def leaf(g, m, v):
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * jnp.square(g)
+            u = (-learning_rate) * (m / c1) / (jnp.sqrt(v / c2) + eps)
+            return u.astype(g.dtype), m, v
+
+        # Flatten-then-unflatten rather than a tree.map returning tuples:
+        # grads trees may themselves contain tuple nodes, which an
+        # is_leaf=isinstance(x, tuple) unzip would mistake for leaf triples.
+        g_flat, treedef = jax.tree.flatten(grads)
+        triples = [leaf(g, m, v) for g, m, v in
+                   zip(g_flat, jax.tree.leaves(state.mu),
+                       jax.tree.leaves(state.nu))]
+        updates = jax.tree.unflatten(treedef, [t[0] for t in triples])
+        mu = jax.tree.unflatten(treedef, [t[1] for t in triples])
+        nu = jax.tree.unflatten(treedef, [t[2] for t in triples])
+        return updates, FusedAdamState(count, mu, nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -30,7 +30,14 @@ def main():
     from ddl25spring_tpu.tokenizers import load_tokenizer
 
     n_dev = len(jax.devices())
-    n_ep = args.ep or min(n_dev, args.experts)
+    if args.ep:
+        n_ep = args.ep
+    else:
+        # Largest expert-axis size that both divides the device count and
+        # divides the expert count evenly (min(n_dev, experts) alone can
+        # violate either, e.g. 4 devices × 3 experts).
+        n_ep = max(e for e in range(1, min(n_dev, args.experts) + 1)
+                   if n_dev % e == 0 and args.experts % e == 0)
     assert n_dev % n_ep == 0, f"--ep {n_ep} must divide device count {n_dev}"
     assert args.experts % n_ep == 0, \
         f"--experts {args.experts} must divide over --ep {n_ep} shards"

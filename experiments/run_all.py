@@ -10,12 +10,17 @@ import json
 import time
 
 
-def main(quick: bool = False, skip=()) -> dict:
+def main(quick: bool = False, skip=(), hw1_sizes=None) -> dict:
     from . import generative, hw1_fl, hw1b_llm, hw2_vfl, hw3_defenses, plots
 
+    if hw1_sizes is not None:
+        hw1_main = lambda quick=False: hw1_fl.main(
+            quick=quick, n_train=hw1_sizes[0], n_test=hw1_sizes[1])
+    else:
+        hw1_main = hw1_fl.main
     summary = {}
     stages = [
-        ("hw1_fl", hw1_fl.main),
+        ("hw1_fl", hw1_main),
         ("hw1b_llm", hw1b_llm.main),
         ("hw2_vfl", hw2_vfl.main),
         ("hw3_defenses", hw3_defenses.main),
@@ -39,5 +44,19 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--skip", nargs="*", default=[])
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU platform (the tunneled TPU in this "
+                         "container can die mid-run, taking hours of "
+                         "artifacts with it; parity protocol does not "
+                         "depend on the platform)")
     a = ap.parse_args()
-    main(quick=a.quick, skip=set(a.skip))
+    hw1_sizes = None
+    if a.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        # The single-core CPU platform cannot chew 60k-sample full-subset
+        # FedSGD grads in reasonable time; 12000/2000 keeps the exact
+        # N/C/E/B/lr/seed protocol (corpus size is not a parity quantity on
+        # synthetic data — hw1_fl.main docstring).
+        hw1_sizes = (12000, 2000)
+    main(quick=a.quick, skip=set(a.skip), hw1_sizes=hw1_sizes)

@@ -135,3 +135,23 @@ def test_forward_loss_matches_forward_plus_loss():
     g_got = jax.grad(lambda p: llama.forward_loss(p, tokens, cfg))(params)
     for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_got)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-6)
+
+
+def test_fused_adam_matches_optax_adam():
+    import optax
+    from ddl25spring_tpu.ops.adam import fused_adam
+    params = {"w": jnp.linspace(-1.0, 1.0, 12).reshape(3, 4),
+              "b": jnp.array([0.5, -0.25, 0.0])}
+    ref_opt, got_opt = optax.adam(1e-2), fused_adam(1e-2)
+    ref_state, got_state = ref_opt.init(params), got_opt.init(params)
+    key = jax.random.key(3)
+    for step in range(5):
+        key, sub = jax.random.split(key)
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(sub, p.shape), params)
+        ref_u, ref_state = ref_opt.update(grads, ref_state, params)
+        got_u, got_state = got_opt.update(grads, got_state, params)
+        for a, b in zip(jax.tree.leaves(ref_u), jax.tree.leaves(got_u)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-6, err_msg=f"step {step}")
+        params = optax.apply_updates(params, ref_u)
