@@ -165,6 +165,7 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     ``temperature``/``top_k`` enable sampling (``key`` required then).
     """
     b, tp = prompt.shape
+    assert max_new_tokens >= 1, max_new_tokens
     if max_len is None:
         max_len = tp + max_new_tokens
     assert max_len >= tp + max_new_tokens, (max_len, tp, max_new_tokens)

@@ -118,8 +118,10 @@ def qkv_proj(block: dict, x: jnp.ndarray, head_dim: int
     fused, 3·288=864 pads to 896 (~4%). The concat copies ~1 MB of weights
     per step — noise next to the matmul. Param tree unchanged, so TP sharding
     (column-sharded wq/wk/wv concat along the sharded axis), checkpoints and
-    stage splitting are unaffected. Shared by training (`attention`) and
-    decoding (models.generate) so the two paths cannot diverge.
+    stage splitting are unaffected. The decode path (models.generate)
+    performs the same split on weights pre-fused once per generate() call —
+    its per-position agreement with this path is asserted in
+    tests/test_generate.py.
     """
     b, t, _ = x.shape
     dl = block["wq"].shape[1]                        # = dmodel / tp_size

@@ -37,6 +37,7 @@ and the same step function pmean-s grads over ``data`` — the 2-pipeline ×
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
@@ -196,6 +197,127 @@ def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
     return loss, grads
 
 
+# ------------------------------------------------------- interleaved layout
+
+def interleave_blocks(blocks, n_stages: int, n_chunks: int):
+    """Permute the stacked [L] block axis into the interleaved-schedule layout.
+
+    The interleaved schedule assigns stage ``s`` the *non-contiguous* virtual
+    stages ``c·S + s`` (chunk c ∈ [0, v)); mesh sharding over ``stage`` always
+    hands each device a *contiguous* slice of the leading axis. Rather than
+    reshard every step, permute once so that the contiguous local slice
+    [s·L/S, (s+1)·L/S) holds exactly stage s's chunks, ordered by c:
+    position ``s·(L/S) + c·per + l`` ← layer ``(c·S + s)·per + l`` with
+    ``per = L/(S·v)``. `deinterleave_blocks` inverts (e.g. before comparing
+    with a GPipe run or exporting a checkpoint in natural layer order).
+    """
+    return jax.tree.map(
+        lambda x: x[_interleave_order(x.shape[0], n_stages, n_chunks)], blocks)
+
+
+def deinterleave_blocks(blocks, n_stages: int, n_chunks: int):
+    """Inverse of `interleave_blocks`."""
+    def inv(x):
+        order = _interleave_order(x.shape[0], n_stages, n_chunks)
+        inverse = jnp.zeros_like(order).at[order].set(jnp.arange(order.size))
+        return x[inverse]
+    return jax.tree.map(inv, blocks)
+
+
+def _interleave_order(n_layers: int, n_stages: int, n_chunks: int) -> jnp.ndarray:
+    assert n_layers % (n_stages * n_chunks) == 0, (n_layers, n_stages, n_chunks)
+    per = n_layers // (n_stages * n_chunks)
+    return jnp.asarray([(c * n_stages + s) * per + l
+                        for s in range(n_stages)
+                        for c in range(n_chunks)
+                        for l in range(per)])
+
+
+def _pipeline_interleaved_loss_and_grad(params: dict, tokens: jnp.ndarray,
+                                        cfg: LlamaConfig, n_stages: int,
+                                        n_microbatches: int, has_data_axis: bool,
+                                        tp: int = 1, n_chunks: int = 2
+                                        ) -> Tuple[jnp.ndarray, dict]:
+    """Interleaved virtual-stage schedule (Megatron-LM's "virtual pipeline"):
+    each stage holds ``v = n_chunks`` non-contiguous layer chunks and every
+    microbatch rides the ICI ring v times, visiting virtual stage c·S+s on
+    its c-th lap. A stage is busy v·M of the v·M + S − 1 ticks, so the
+    bubble fraction drops from GPipe's (S−1)/(M+S−1) to (S−1)/(v·M+S−1) —
+    the fill/drain cost is amortized over v× more (smaller) stage visits.
+
+    Injection is grouped: microbatches enter in waves of S (ticks where
+    (j − s) mod v·S < S present stage 0 with a fresh microbatch; on all other
+    ticks its input is the wrap-around of an in-flight lap), so M must be a
+    multiple of S. At tick j, stage s works on relative tick r = j − s:
+    group g = r // (v·S), chunk c = (r mod v·S) // S, microbatch
+    g·S + (r mod S); valid iff 0 ≤ r < v·M. The loss exits at stage S−1 on
+    chunk v−1. Backward is the autodiff transpose of the whole scan (GPipe
+    semantics): simple and exact, at the cost of stashing O(v·M) microbatch
+    activations — combine with ``cfg.remat`` when memory matters; the 1F1B
+    O(S) stash bound does not apply to this schedule.
+
+    ``params["blocks"]`` must be in `interleave_blocks` layout (the local
+    [L/S] slice is [v, per] chunk-major): permute with
+    ``dict(params, blocks=interleave_blocks(params["blocks"], S, v))``
+    BEFORE ``init_state`` places the tree on the mesh (a later permute
+    across the sharded stage axis would be an all-to-all). The layout is
+    shape-identical to the natural one, so it cannot be asserted here —
+    natural-layout params silently run layers in the wrong order.
+    """
+    stage = lax.axis_index("stage")
+    is_first = stage == 0
+    is_last = stage == n_stages - 1
+    tp_axis = "model" if tp > 1 else None
+    v = n_chunks
+    b, t = tokens.shape
+    assert b % n_microbatches == 0, (b, n_microbatches)
+    assert n_microbatches % n_stages == 0, (n_microbatches, n_stages)
+    mb = b // n_microbatches
+    tokens_mb = tokens.reshape(n_microbatches, mb, t)
+    n_ticks = v * n_microbatches + n_stages - 1
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def loss_fn(p: dict) -> jnp.ndarray:
+        # Local blocks [L/S, ...] → [v, per, ...], chunk-major by layout.
+        n_local = jax.tree.leaves(p["blocks"])[0].shape[0]
+        per = n_local // v
+        chunks = jax.tree.map(
+            lambda x: x.reshape((v, per) + x.shape[1:]), p["blocks"])
+
+        def tick(carry, j):
+            x_prev, loss_sum = carry
+            r = j - stage
+            valid = (r >= 0) & (r < v * n_microbatches)
+            cyc = jnp.mod(r, v * n_stages)
+            c = jnp.clip(cyc // n_stages, 0, v - 1)
+            mb_idx = jnp.clip(r // (v * n_stages) * n_stages
+                              + jnp.mod(cyc, n_stages),
+                              0, n_microbatches - 1)
+            tok = tokens_mb[mb_idx]
+            inject = is_first & (cyc < n_stages)
+            x_in = jnp.where(inject[..., None, None, None],
+                             llama.embed(p, tok, cfg), x_prev)
+            chunk_c = jax.tree.map(
+                lambda x: lax.dynamic_index_in_dim(x, c, keepdims=False),
+                chunks)
+            h = llama.blocks_apply(chunk_c, x_in, cfg, tp_axis=tp_axis)
+            exit_here = is_last & (c == v - 1) & valid
+            mb_loss = lax.cond(
+                exit_here,
+                lambda: llama.head_loss(p, h, tok, cfg),
+                lambda: jnp.zeros((), jnp.float32))
+            x_next = lax.ppermute(h, "stage", fwd)
+            return (x_next, loss_sum + mb_loss), None
+
+        x0 = jnp.zeros((mb, t, cfg.dmodel), jnp.dtype(cfg.dtype))
+        (_, loss_sum), _ = lax.scan(
+            tick, (x0, jnp.zeros((), jnp.float32)), jnp.arange(n_ticks))
+        return loss_sum / n_microbatches / tp   # same seeding rule as GPipe
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp)
+
+
 def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                                  n_stages: int, n_microbatches: int,
                                  has_data_axis: bool,
@@ -298,7 +420,7 @@ def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaCo
 
 def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
                        mesh: Mesh, n_microbatches: int = 1,
-                       schedule: str = "gpipe") -> Callable:
+                       schedule: str = "gpipe", n_chunks: int = 2) -> Callable:
     """jit-compiled pipeline train step over mesh axes (data, stage).
 
     ``n_microbatches=1`` degenerates to the reference's naive staged pipeline
@@ -307,8 +429,12 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     ``model`` axis gives the full 3-D DP×PP×TP layout.
 
     ``schedule`` selects "gpipe" (autodiff-transposed forward scan, O(M)
-    activation memory) or "1f1b" (interleaved hand-written backward, O(S)
-    activation memory) — both compute the identical gradient.
+    activation memory), "1f1b" (interleaved hand-written backward, O(S)
+    activation memory), or "interleaved" (virtual-stage schedule with
+    ``n_chunks`` chunks per stage — smallest bubble, O(v·M) memory;
+    requires ``params["blocks"]`` in `interleave_blocks` layout and
+    n_microbatches divisible by n_stages) — all compute the identical
+    gradient.
 
     Returns ``step(state, tokens) -> (state, loss)`` where tokens is the
     global [B, T] batch, B divisible by data_size · n_microbatches.
@@ -317,7 +443,10 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     has_data = mesh.shape.get("data", 1) > 1
     tp = mesh.shape.get("model", 1)
     body = {"gpipe": _pipeline_loss_and_grad,
-            "1f1b": _pipeline_1f1b_loss_and_grad}[schedule]
+            "1f1b": _pipeline_1f1b_loss_and_grad,
+            "interleaved": functools.partial(
+                _pipeline_interleaved_loss_and_grad, n_chunks=n_chunks),
+            }[schedule]
 
     def sharded_grads(params, tokens):
         return body(params, tokens, cfg, n_stages,

@@ -1,10 +1,11 @@
-"""GPipe vs 1F1B pipeline schedule measurements: step time + compiled memory.
+"""Pipeline schedule measurements (GPipe / 1F1B / interleaved): step time +
+compiled memory.
 
 The reference names 1F1B but implements a naive schedule
-(lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py); this framework implements both
-GPipe (autodiff-transposed scan) and true interleaved 1F1B
-(parallel/pp.py). Their gradients are bit-equivalent (tests/test_pp.py);
-what differs is the resource profile:
+(lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py); this framework implements GPipe
+(autodiff-transposed scan), true 1F1B, and the interleaved virtual-stage
+schedule (parallel/pp.py). Their gradients are bit-equivalent
+(tests/test_pp.py); what differs is the resource profile:
 
 - GPipe saves every tick's stage input for the backward replay — activation
   memory O(n_microbatches).
@@ -12,6 +13,10 @@ what differs is the resource profile:
   stage forward in its hand-written backward — memory O(n_stages), compute
   +1 forward per microbatch (Megatron-LM's full-recompute setting). The
   matched-memory GPipe comparison point is ``remat=True``.
+- interleaved (v=2 virtual chunks per stage) shrinks the bubble fraction to
+  (S−1)/(v·M+S−1) at O(v·M) activation memory; its wall-clock win needs a
+  real multi-chip ring (v× more, smaller ppermute hops), so on this CPU
+  mesh only the memory/loss columns are meaningful.
 
 The bench host has ONE real chip, so a multi-stage mesh cannot run on real
 hardware here; measurements run on the virtual 8-device CPU mesh (wall
@@ -53,12 +58,20 @@ def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
         jax.random.key(1), (batch_per_mb * n_microbatches, cfg.ctx_size), 0,
         cfg.vocab_size)
 
+    n_chunks = 2
+    schedules = ["gpipe", "1f1b"]
+    if (cfg.n_layers % (n_stages * n_chunks) == 0
+            and n_microbatches % n_stages == 0):
+        schedules.append("interleaved")   # v=2 virtual chunks per stage
     out: Dict[str, Dict[str, float]] = {}
-    for schedule in ("gpipe", "1f1b"):
+    for schedule in schedules:
         params = llama.init_llama(jax.random.key(0), cfg)
+        if schedule == "interleaved":
+            params = dict(params, blocks=pp.interleave_blocks(
+                params["blocks"], n_stages, n_chunks))
         state = pp.init_state(mesh, params, optimizer)
         step = pp.make_pipeline_step(cfg, optimizer, mesh, n_microbatches,
-                                     schedule=schedule)
+                                     schedule=schedule, n_chunks=n_chunks)
         batch = pp.shard_batch(mesh, tokens)
         lowered = step.lower(state, batch)
         compiled = lowered.compile()

@@ -132,3 +132,77 @@ def test_stage_split_roundtrip():
     stages = llama.split_stages(params, 4)
     merged = llama.merge_stages(stages)
     _assert_trees_close(params, merged, 0)
+
+
+@pytest.mark.parametrize("n_stages,n_microbatches,n_chunks",
+                         [(2, 4, 2), (2, 2, 2), (2, 8, 2)])
+def test_interleaved_matches_single_device(devices, n_stages, n_microbatches,
+                                           n_chunks):
+    """The virtual-stage schedule must still be the full-batch gradient.
+
+    Params go in through `interleave_blocks` (each stage's contiguous shard
+    holds its v non-contiguous chunks) and come back through
+    `deinterleave_blocks` for comparison in natural layer order."""
+    params, tokens = _params_and_tokens()
+    optimizer = optax.sgd(0.1)
+    ref_loss, ref_params = _reference_step(params, tokens, optimizer,
+                                           n_microbatches)
+
+    inter = dict(params, blocks=pp.interleave_blocks(params["blocks"],
+                                                     n_stages, n_chunks))
+    mesh = make_mesh({"stage": n_stages}, devices=devices[:n_stages])
+    state = pp.init_state(mesh, inter, optimizer)
+    step = pp.make_pipeline_step(CFG, optimizer, mesh, n_microbatches,
+                                 schedule="interleaved", n_chunks=n_chunks)
+    state, loss = step(state, pp.shard_batch(mesh, tokens))
+
+    got = jax.device_get(state.params)
+    got = dict(got, blocks=pp.deinterleave_blocks(got["blocks"],
+                                                  n_stages, n_chunks))
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    _assert_trees_close(got, jax.device_get(ref_params), 2e-5)
+
+
+def test_interleave_blocks_roundtrip():
+    params, _ = _params_and_tokens()
+    inter = pp.interleave_blocks(params["blocks"], 2, 2)
+    back = pp.deinterleave_blocks(inter, 2, 2)
+    _assert_trees_close(back, params["blocks"], 0)
+    # And the permutation actually moves layers: stage 0's slice must hold
+    # natural layers [0, 2] (chunks c=0,1 at s=0 for S=2, v=2, per=1).
+    wq = params["blocks"]["wq"]
+    np.testing.assert_array_equal(np.asarray(inter["wq"][0]), np.asarray(wq[0]))
+    np.testing.assert_array_equal(np.asarray(inter["wq"][1]), np.asarray(wq[2]))
+
+
+def test_interleaved_matches_single_device_s4(devices):
+    """S=4 exercises the grouped-injection index math (wave windows, lap
+    wrap-around) that the S=2 cases cannot: needs an 8-layer model so
+    L % (S·v) == 0."""
+    cfg = LlamaConfig(vocab_size=64, dmodel=16, num_heads=2, n_layers=8,
+                      ctx_size=8)
+    params = llama.init_llama(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, cfg.ctx_size), 0, 64)
+    optimizer = optax.sgd(0.1)
+
+    def loss_fn(p):
+        mbs = tokens.reshape(8, -1, tokens.shape[-1])
+        return jax.vmap(
+            lambda t: causal_lm_loss(llama.forward(p, t, cfg), t))(mbs).mean()
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params)
+    opt_state = optimizer.init(params)
+    updates, _ = optimizer.update(ref_grads, opt_state, params)
+    ref_params = optax.apply_updates(params, updates)
+
+    inter = dict(params, blocks=pp.interleave_blocks(params["blocks"], 4, 2))
+    mesh = make_mesh({"stage": 4}, devices=devices[:4])
+    state = pp.init_state(mesh, inter, optimizer)
+    step = pp.make_pipeline_step(cfg, optimizer, mesh, n_microbatches=8,
+                                 schedule="interleaved", n_chunks=2)
+    state, loss = step(state, pp.shard_batch(mesh, tokens))
+
+    got = jax.device_get(state.params)
+    got = dict(got, blocks=pp.deinterleave_blocks(got["blocks"], 4, 2))
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    _assert_trees_close(got, jax.device_get(ref_params), 2e-5)
