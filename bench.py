@@ -93,19 +93,27 @@ def time_batch(mesh, cfg, batch_size: int) -> float:
 
 
 def main():
-    cfg = LlamaConfig(dtype="bfloat16")   # canonical 288/6/6, bf16 compute
+    import dataclasses
+    base = LlamaConfig(dtype="bfloat16")  # canonical 288/6/6, bf16 compute
     n_dev = len(jax.devices())
     mesh = make_mesh({"data": n_dev})
 
-    best_bs, best_tps = None, 0.0
-    for bs in (32, 64, 128, 256):
-        tps = time_batch(mesh, cfg, bs)
-        print(f"batch {bs:4d}: {tps/n_dev:12.0f} tok/s/chip", file=sys.stderr)
-        if tps > best_tps:
-            best_bs, best_tps = bs, tps
+    best = (None, None, 0.0)              # (batch, softmax_dtype, tokens/s)
+    for sm in ("float32", "bfloat16"):
+        # bf16 scores: the framework's documented throughput knob (fp32
+        # softmax max/denominator, ~1e-2 logit drift — config.py, tested in
+        # tests/test_models.py). Same model, same step semantics.
+        cfg = dataclasses.replace(base, softmax_dtype=sm)
+        for bs in (32, 64, 128):
+            tps = time_batch(mesh, cfg, bs)
+            print(f"batch {bs:4d} softmax={sm:8s}: {tps/n_dev:12.0f} "
+                  f"tok/s/chip", file=sys.stderr)
+            if tps > best[2]:
+                best = (bs, sm, tps)
 
+    best_bs, best_sm, best_tps = best
     per_chip = best_tps / n_dev
-    flops_tok = train_step_flops_per_token(cfg, SEQ)
+    flops_tok = train_step_flops_per_token(base, SEQ)
     mfu = per_chip * flops_tok / peak_flops_per_chip()
     print(json.dumps({
         "metric": "tiny_llama_train_tokens_per_sec_per_chip",
@@ -115,6 +123,7 @@ def main():
         "mfu": round(mfu, 4),
         "flops_per_token": int(flops_tok),
         "batch_size": best_bs,
+        "softmax_dtype": best_sm,
     }))
 
 

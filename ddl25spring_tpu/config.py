@@ -66,6 +66,16 @@ class LlamaConfig:
     # experiments/attn_bench.py).
     attention_impl: str = "auto"
     flash_min_seq: int = 4096
+    # Dtype of the materialized [B·H, T, T] attention score tensor. The
+    # default fp32 is what the PP/SP equivalence tests are calibrated to;
+    # "bfloat16" halves the attention leg's dominant HBM tensor (softmax
+    # max/denominator stay fp32) at ~1e-2 logit drift — an opt-in throughput
+    # knob, measured ~9% on standalone attention fwd+bwd (ROOFLINE.md).
+    # Applies to the XLA attention path only: the pallas flash kernel never
+    # materializes the score tensor in the first place (fp32 accumulators,
+    # tile-local scores), and SP's ring attention owns its own fp32
+    # online-softmax accumulation — on those paths this knob is a no-op.
+    softmax_dtype: str = "float32"
     # Rematerialize block activations in backward (jax.checkpoint) — trades
     # FLOPs for HBM, the TPU-native answer to activation memory pressure.
     remat: bool = False

@@ -1,0 +1,63 @@
+"""Train-then-sample: tiny-Llama on the token stream, then KV-cache decoding.
+
+No reference counterpart: the course stack only trains (SURVEY.md §2.9
+lists no generation surface in the simplellm API it uses). This is the
+framework's inference mode — one jitted program per phase: the DP train
+step (fused projections + fused Adam), then models.generate's prefill +
+single-token decode scan with in-place cache writes.
+
+    python examples/generate_text.py --iters 200 --new-tokens 64
+    python examples/generate_text.py --temperature 0.8 --top-k 40
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser(iters=200, batch=8)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy; >0 samples")
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--prompt", type=str, default="Once upon a time")
+    args = ap.parse_args()
+    setup_devices(args)
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.data.tokens import TokenStream
+    from ddl25spring_tpu.models import generate, llama
+    from ddl25spring_tpu.ops import fused_adam
+    from ddl25spring_tpu.parallel import dp, make_mesh
+    from ddl25spring_tpu.tokenizers import load_tokenizer
+
+    tok = load_tokenizer()
+    cfg = LlamaConfig(vocab_size=tok.vocab_size, ctx_size=128)
+    mesh = make_mesh({"data": 1})
+    opt = fused_adam(8e-4)
+    state = dp.replicate(
+        mesh, dp.init_state(llama.init_llama(jax.random.key(0), cfg), opt))
+    step = dp.make_grad_aggregation_step(
+        lambda p, b: llama.forward_loss(p, b, cfg), opt, mesh)
+
+    stream = iter(TokenStream(tok, args.batch, cfg.ctx_size))
+    for i in range(args.iters):
+        state, loss = step(state, dp.shard_batch(mesh, next(stream)))
+        if i % max(1, args.iters // 10) == 0:
+            print(f"iter {i:4d}: loss {float(loss):.4f}")
+
+    ids = tok.encode(args.prompt)[: cfg.ctx_size // 2] or [1]
+    prompt = jnp.asarray([ids], jnp.int32)
+    out = generate.generate(
+        state.params, prompt, cfg, args.new_tokens,
+        key=jax.random.key(7), temperature=args.temperature,
+        top_k=args.top_k or None)
+    print("prompt    :", args.prompt)
+    print("completion:", tok.decode(out[0].tolist()))
+
+
+if __name__ == "__main__":
+    main()

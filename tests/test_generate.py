@@ -98,3 +98,21 @@ def test_padding_idx_zero_embedding_in_decode():
         logits, cache = generate.forward_cached(
             params, tokens[:, t:t + 1], cache, t, cfg)
         assert jnp.allclose(logits, full[:, t, :], atol=1e-4), t
+
+
+def test_generate_with_sharded_params_and_batch(params, devices):
+    """Distributed inference: params replicated / batch sharded over a
+    ``data`` mesh axis must decode exactly what one device decodes —
+    jit partitions the whole prefill+decode program via GSPMD."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ddl25spring_tpu.parallel import make_mesh
+
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (4, 5), 0,
+                                CFG.vocab_size)
+    want = generate.generate(params, prompt, CFG, 6)
+
+    mesh = make_mesh({"data": 2}, devices=devices[:2])
+    p_sh = jax.device_put(params, NamedSharding(mesh, P()))
+    prompt_sh = jax.device_put(prompt, NamedSharding(mesh, P("data")))
+    got = generate.generate(p_sh, prompt_sh, CFG, 6)
+    assert jnp.array_equal(want, got)

@@ -139,3 +139,24 @@ def test_vfl_vae_hybrid():
     assert [r.shape for r in recons] == [(6, 4), (6, 4), (6, 3), (6, 3)]
     total, recon, kl = vfl_nets.vfl_vae_loss(recons, xs, mu, logvar)
     assert float(total) == pytest.approx(float(recon) + float(kl), rel=1e-6)
+
+
+def test_bf16_softmax_close_to_fp32():
+    """The opt-in bf16 score tensor (softmax_dtype="bfloat16") must track the
+    fp32 path within its documented ~1e-2 drift, and keep probabilities
+    normalized (fp32 denominator)."""
+    import dataclasses
+    params = llama.init_llama(jax.random.key(0), TINY)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 256)
+    ref = llama.forward(params, tokens, TINY)
+    cfg16 = dataclasses.replace(TINY, softmax_dtype="bfloat16")
+    got = llama.forward(params, tokens, cfg16)
+    assert bool(jnp.isfinite(got).all())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-2)
+    # Gradients stay finite and close in direction.
+    g_ref = jax.grad(lambda p: causal_lm_loss(llama.forward(p, tokens, TINY), tokens))(params)
+    g_got = jax.grad(lambda p: causal_lm_loss(llama.forward(p, tokens, cfg16), tokens))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_got)):
+        assert bool(jnp.isfinite(b).all())
+        denom = float(jnp.linalg.norm(a.reshape(-1))) or 1.0
+        assert float(jnp.linalg.norm((b - a).reshape(-1))) / denom < 0.1
