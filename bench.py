@@ -18,27 +18,14 @@ Adam). vs_baseline is the speedup over that number.
 """
 
 import json
-import subprocess
 import sys
 import time
 
+from ddl25spring_tpu.utils.probe import probe_default_platform
 
-def _default_platform_responsive(timeout: float = 180.0):
-    """Probe the default jax platform in a SUBPROCESS. The tunneled TPU in
-    this container can wedge such that every jax op (even jax.devices())
-    hangs forever; the bench contract is ONE JSON line, so a dead runtime
-    must fail over, not hang. Returns the platform name or None."""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return None
-    return out.stdout.strip() if out.returncode == 0 else None
-
-
-PLATFORM = _default_platform_responsive()
+# Probe in a subprocess: a wedged accelerator runtime must fail over to
+# CPU, not hang the bench (its contract is ONE JSON line).
+PLATFORM, _ = probe_default_platform()
 import jax  # noqa: E402
 
 if PLATFORM is None:

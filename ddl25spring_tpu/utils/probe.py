@@ -1,0 +1,38 @@
+"""Subprocess probe of the default jax platform.
+
+The tunneled TPU in the bench environment can wedge so that every jax op in
+the calling process — even ``jax.devices()`` — hangs forever. Anything that
+must not hang (the headline bench, the driver's multichip dryrun) therefore
+asks a THROWAWAY subprocess what the default platform looks like: a wedged
+runtime times the probe out, a broken one crashes it, and either way the
+caller survives and can pin the CPU platform instead.
+
+Parsing takes the LAST stdout line: this container's sitecustomize can emit
+warnings before the probed value.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+
+def probe_default_platform(timeout: float = 180.0
+                           ) -> Tuple[Optional[str], int]:
+    """Returns (platform_name, device_count) of the default jax backend,
+    or (None, 0) if the probe times out, crashes, or prints garbage."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+            capture_output=True, text=True, timeout=timeout)
+    except (subprocess.TimeoutExpired, OSError):
+        return None, 0
+    if out.returncode != 0:
+        return None, 0
+    try:
+        platform, n = out.stdout.strip().splitlines()[-1].split()
+        return platform, int(n)
+    except (ValueError, IndexError):
+        return None, 0
