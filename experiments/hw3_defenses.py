@@ -93,7 +93,7 @@ def run_one(defense: str, iid: bool, sink, provenance: str, *, rounds: int,
 def main(quick: bool = False, n_train: int = 60000, n_test: int = 10000
          ) -> Dict[str, float]:
     """See hw1_fl.main on n_train/n_test: the committed CPU run uses
-    6000/1500 (synthetic MNIST; protocol knobs exact)."""
+    6000/2000 (run_all --cpu; synthetic MNIST; protocol knobs exact)."""
     provenance = common.mnist_provenance()
     if quick:
         n_train, n_test = 2000, 500

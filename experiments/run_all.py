@@ -10,20 +10,21 @@ import json
 import time
 
 
-def main(quick: bool = False, skip=(), hw1_sizes=None) -> dict:
+def main(quick: bool = False, skip=(), hw1_sizes=None, hw3_sizes=None) -> dict:
     from . import generative, hw1_fl, hw1b_llm, hw2_vfl, hw3_defenses, plots
 
-    if hw1_sizes is not None:
-        hw1_main = lambda quick=False: hw1_fl.main(
-            quick=quick, n_train=hw1_sizes[0], n_test=hw1_sizes[1])
-    else:
-        hw1_main = hw1_fl.main
+    def sized(fn, sizes):
+        if sizes is None:
+            return fn
+        return lambda quick=False: fn(quick=quick, n_train=sizes[0],
+                                      n_test=sizes[1])
+
     summary = {}
     stages = [
-        ("hw1_fl", hw1_main),
+        ("hw1_fl", sized(hw1_fl.main, hw1_sizes)),
         ("hw1b_llm", hw1b_llm.main),
         ("hw2_vfl", hw2_vfl.main),
-        ("hw3_defenses", hw3_defenses.main),
+        ("hw3_defenses", sized(hw3_defenses.main, hw3_sizes)),
         ("generative", generative.main),
     ]
     for name, fn in stages:
@@ -50,13 +51,16 @@ if __name__ == "__main__":
                          "artifacts with it; parity protocol does not "
                          "depend on the platform)")
     a = ap.parse_args()
-    hw1_sizes = None
+    hw1_sizes = hw3_sizes = None
     if a.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-        # The single-core CPU platform cannot chew 60k-sample full-subset
-        # FedSGD grads in reasonable time; 12000/2000 keeps the exact
-        # N/C/E/B/lr/seed protocol (corpus size is not a parity quantity on
-        # synthetic data — hw1_fl.main docstring).
+        # The single-core CPU platform cannot chew 60k-sample corpora in
+        # reasonable time; smaller synthetic corpora keep the exact
+        # N/C/E/B/lr/seed protocols (corpus size is not a parity quantity
+        # on synthetic data — hw1_fl.main docstring). hw3 runs its 21-config
+        # grid, so it gets the smallest corpus.
         hw1_sizes = (12000, 2000)
-    main(quick=a.quick, skip=set(a.skip), hw1_sizes=hw1_sizes)
+        hw3_sizes = (6000, 2000)
+    main(quick=a.quick, skip=set(a.skip), hw1_sizes=hw1_sizes,
+         hw3_sizes=hw3_sizes)
