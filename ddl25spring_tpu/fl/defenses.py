@@ -143,10 +143,15 @@ def norm_clipping(flat: jnp.ndarray, ratio: float = 1.0) -> jnp.ndarray:
 
 def bulyan(flat: jnp.ndarray, n_malicious: int, k: int, beta: float) -> jnp.ndarray:
     """Multi-Krum preselect k survivors, then coordinate trimmed-mean over
-    them (hw03 cell 15; guard k > 2·β·k like the reference's n>2β·n_sel)."""
-    assert k - 2 * int(beta * k) > 0, "trim would consume all survivors"
+    them (hw03 cell 15). When the trim would consume all survivors
+    (k ≤ 2·int(β·k), e.g. every β=0.6 grid cell), the reference silently
+    skips trimming and means the multi-krum winners as-is (cell 15's
+    ``else: trimmed_updates = sorted_updates`` branch) — reproduced here,
+    since the hw3 grid sweeps exactly those infeasible cells."""
     winners = multi_krum(flat, n_malicious, k)
-    return trimmed_mean(flat[winners], beta)
+    if k - 2 * int(beta * k) > 0:
+        return trimmed_mean(flat[winners], beta)
+    return flat[winners].mean(axis=0)
 
 
 def sparse_fed(flat: jnp.ndarray, topk_fraction: float, *, clip_ratio: float = 1.0

@@ -179,3 +179,18 @@ def test_backdoor_asr_pipeline(fl_attack_setup):
     trig_pred = np.asarray(server.apply_fn(server.params, atk.trigger_test_set(xt)).argmax(-1))
     clean_acc, asr = backdoor_metrics(clean_pred, np.asarray(yt), trig_pred, 0)
     assert 0.0 <= asr <= 1.0 and 0.0 <= clean_acc <= 1.0
+
+
+def test_bulyan_infeasible_trim_falls_back_to_mean():
+    """Reference parity (hw03 cell 15): when k <= 2*int(beta*k) the trim
+    would consume every survivor, and the reference's else-branch silently
+    means the multi-krum winners untrimmed — e.g. every beta=0.6 grid cell."""
+    rng = np.random.default_rng(0)
+    honest = rng.normal(0, 0.1, size=(8, 6)).astype(np.float32)
+    flat = jnp.asarray(np.concatenate([honest, -5 * honest[:2]]))
+    k, beta = 4, 0.6                       # int(0.6*4)=2; 4 - 2*2 = 0 -> fallback
+    out = defenses.bulyan(flat, n_malicious=2, k=k, beta=beta)
+    winners = defenses.multi_krum(flat, n_malicious=2, k=k)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(flat[winners].mean(axis=0)),
+                               rtol=1e-6)
