@@ -169,12 +169,13 @@ def _xla_attention(q, k, v, *, causal: bool, q_offset: int = 0,
     if st == jnp.float32:
         probs = jax.nn.softmax(scores, axis=-1)
     else:
-        # bf16 scores; subtract the fp32 row max, exponentiate and normalize
-        # with an fp32 denominator — only the [T, T]-sized tensors stay bf16.
+        # bf16 scores; subtract the fp32 row max, then divide in fp32 (the
+        # upcast/divide/downcast fuses into one elementwise kernel, so no
+        # fp32 [T, T] tensor ever hits HBM) — only the stored [T, T]-sized
+        # tensors stay bf16.
         m = jnp.max(scores.astype(jnp.float32), axis=-1, keepdims=True)
-        e = jnp.exp(scores - m.astype(st))
-        denom = jnp.sum(e.astype(jnp.float32), axis=-1, keepdims=True)
-        probs = e / denom.astype(st)
+        e = jnp.exp(scores - m.astype(st)).astype(jnp.float32)
+        probs = e / jnp.sum(e, axis=-1, keepdims=True)
     probs = probs.astype(q.dtype)
     out = lax.dot_general(probs, vm, (((2,), (1,)), ((0,), (0,))))
     return out.reshape(b, h, tq, dh).transpose(0, 2, 1, 3)

@@ -224,6 +224,42 @@ def deinterleave_blocks(blocks, n_stages: int, n_chunks: int):
     return jax.tree.map(inv, blocks)
 
 
+# The interleaved layout is shape-identical to the natural one, so a layout
+# mistake cannot be caught from the arrays. interleave_params tags the tree
+# with a scalar sentinel (value encodes S and v) that make_pipeline_step
+# verifies on the first call — natural-layout params under
+# schedule="interleaved" (or vice versa) fail loudly instead of silently
+# running layers in the wrong order. The sentinel is a float32 leaf; its
+# grad is identically zero so plain Adam/SGD leave it alone, and
+# make_pipeline_step additionally re-pins it after every optimizer update so
+# params-coupled transforms (adamw weight decay, EMA) cannot drift it.
+_LAYOUT_KEY = "blocks_layout"
+
+
+def _layout_tag(n_stages: int, n_chunks: int) -> float:
+    return float(n_stages * 1000 + n_chunks)
+
+
+def interleave_params(params: dict, n_stages: int, n_chunks: int) -> dict:
+    """`interleave_blocks` over the full param tree, plus the layout tag.
+
+    Use this (not a bare ``dict(params, blocks=interleave_blocks(...))``)
+    before ``init_state`` when training with ``schedule="interleaved"``.
+    """
+    out = dict(params, blocks=interleave_blocks(params["blocks"],
+                                                n_stages, n_chunks))
+    out[_LAYOUT_KEY] = jnp.float32(_layout_tag(n_stages, n_chunks))
+    return out
+
+
+def deinterleave_params(params: dict, n_stages: int, n_chunks: int) -> dict:
+    """Inverse of `interleave_params` (natural layer order, tag stripped)."""
+    out = dict(params, blocks=deinterleave_blocks(params["blocks"],
+                                                  n_stages, n_chunks))
+    out.pop(_LAYOUT_KEY, None)
+    return out
+
+
 def _interleave_order(n_layers: int, n_stages: int, n_chunks: int) -> jnp.ndarray:
     assert n_layers % (n_stages * n_chunks) == 0, (n_layers, n_stages, n_chunks)
     per = n_layers // (n_stages * n_chunks)
@@ -258,11 +294,11 @@ def _pipeline_interleaved_loss_and_grad(params: dict, tokens: jnp.ndarray,
 
     ``params["blocks"]`` must be in `interleave_blocks` layout (the local
     [L/S] slice is [v, per] chunk-major): permute with
-    ``dict(params, blocks=interleave_blocks(params["blocks"], S, v))``
-    BEFORE ``init_state`` places the tree on the mesh (a later permute
-    across the sharded stage axis would be an all-to-all). The layout is
-    shape-identical to the natural one, so it cannot be asserted here —
-    natural-layout params silently run layers in the wrong order.
+    ``interleave_params(params, S, v)`` BEFORE ``init_state`` places the
+    tree on the mesh (a later permute across the sharded stage axis would
+    be an all-to-all). The layout is shape-identical to the natural one so
+    it cannot be asserted from the arrays; `make_pipeline_step` checks the
+    `interleave_params` layout tag on the first call instead.
     """
     stage = lax.axis_index("stage")
     is_first = stage == 0
@@ -432,9 +468,9 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     activation memory), "1f1b" (interleaved hand-written backward, O(S)
     activation memory), or "interleaved" (virtual-stage schedule with
     ``n_chunks`` chunks per stage — smallest bubble, O(v·M) memory;
-    requires ``params["blocks"]`` in `interleave_blocks` layout and
-    n_microbatches divisible by n_stages) — all compute the identical
-    gradient.
+    requires params permuted via `interleave_params` — checked loudly on
+    the first step — and n_microbatches divisible by n_stages) — all
+    compute the identical gradient.
 
     Returns ``step(state, tokens) -> (state, loss)`` where tokens is the
     global [B, T] batch, B divisible by data_size · n_microbatches.
@@ -462,9 +498,47 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
         )(state.params, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        if _LAYOUT_KEY in params:
+            # Keep the layout tag exactly invariant under ANY optimizer —
+            # zero grad does not protect it from params-coupled transforms
+            # like decoupled weight decay.
+            params = dict(params, **{_LAYOUT_KEY: state.params[_LAYOUT_KEY]})
         return TrainState(params, opt_state, state.step + 1), loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    jitted = jax.jit(step, donate_argnums=(0,))
+
+    # Layout guard (first call only — params are concrete at the Python call
+    # boundary, and reading the scalar here avoids a per-step host sync):
+    # schedule="interleaved" demands the interleave_params tag for exactly
+    # this (S, v); any other schedule demands its absence.
+    checked = []
+
+    def guarded(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
+        if not checked:
+            tag = state.params.get(_LAYOUT_KEY)
+            if schedule == "interleaved":
+                want = _layout_tag(n_stages, n_chunks)
+                if tag is None:
+                    raise ValueError(
+                        "schedule='interleaved' requires params permuted with "
+                        "interleave_params(params, n_stages, n_chunks) before "
+                        "init_state — natural-layout blocks would run layers "
+                        "in the wrong order")
+                if float(tag) != want:
+                    raise ValueError(
+                        f"params were interleaved for a different topology "
+                        f"(tag {float(tag):.0f}, expected {want:.0f} = "
+                        f"stages*1000+chunks)")
+            elif tag is not None:
+                raise ValueError(
+                    f"params carry the interleaved layout tag but "
+                    f"schedule={schedule!r} expects natural layer order — "
+                    f"undo with deinterleave_params first")
+            checked.append(True)
+        return jitted(state, tokens)
+
+    guarded.lower = jitted.lower   # AOT inspection (experiments/pp_schedules)
+    return guarded
 
 
 from .mesh import shard_batch  # noqa: E402,F401  (shared batch placement)

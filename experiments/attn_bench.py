@@ -3,10 +3,12 @@
 Times forward and forward+backward of the two attention backends
 (models/llama._xla_attention vs ops/flash_attention.flash_attention) at the
 model's head geometry (H=6, Dh=48) on the real accelerator, holding
-tokens-per-call constant. Results → ``experiments/results/attn_bench.csv``;
-the committed copy is a real-TPU (v5e) run.
+tokens-per-call constant. Results → ``experiments/results/attn_bench.csv``
+(each row carries a ``platform`` column; a CSV is only evidence for the
+``flash_min_seq`` crossover if that column says tpu — run this on the chip
+and commit the output when the tunnel is up).
 
-Context for the numbers (see also the committed results): at Dh=48 the
+Expected shape of the numbers: at Dh=48 the
 flash kernel pads the lane dimension to 128, wasting ~62% of each MXU pass,
 while XLA's fused softmax handles the canonical T=256 shape well — so flash
 only catches up around T≈4096, where the O(T²) score materialization starts

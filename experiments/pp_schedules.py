@@ -40,7 +40,7 @@ from typing import Dict
 
 
 def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
-            repeats: int = 5) -> Dict[str, Dict[str, float]]:
+            repeats: int = 5, n_layers: int = 8) -> Dict[str, Dict[str, float]]:
     import jax
     import numpy as np
     import optax
@@ -49,8 +49,10 @@ def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
     from ddl25spring_tpu.models import llama
     from ddl25spring_tpu.parallel import make_mesh, pp
 
-    cfg = LlamaConfig(vocab_size=512, dmodel=64, num_heads=4, n_layers=8,
-                      ctx_size=64)  # 8 layers: divisible by 2/4/8 stages
+    # 8 layers divides 2/4/8 stages; the 8-stage row needs 16 so the
+    # interleaved schedule (S·v=16 chunks) exists there too.
+    cfg = LlamaConfig(vocab_size=512, dmodel=64, num_heads=4,
+                      n_layers=n_layers, ctx_size=64)
     devices = jax.devices()[:n_stages]
     mesh = make_mesh({"stage": n_stages}, devices=devices)
     optimizer = optax.sgd(0.1)
@@ -67,8 +69,7 @@ def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
     for schedule in schedules:
         params = llama.init_llama(jax.random.key(0), cfg)
         if schedule == "interleaved":
-            params = dict(params, blocks=pp.interleave_blocks(
-                params["blocks"], n_stages, n_chunks))
+            params = pp.interleave_params(params, n_stages, n_chunks)
         state = pp.init_state(mesh, params, optimizer)
         step = pp.make_pipeline_step(cfg, optimizer, mesh, n_microbatches,
                                      schedule=schedule, n_chunks=n_chunks)
@@ -97,9 +98,11 @@ def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
     grid = [(2, 8)] if quick else [(2, 8), (4, 16), (8, 32)]
     results = {}
     for s, m in grid:
-        r = measure(s, m)
+        n_layers = 16 if s == 8 else 8   # see measure(): interleaved needs S·v | L
+        r = measure(s, m, n_layers=n_layers)
         for schedule, vals in r.items():
             sink.write({"n_stages": s, "n_microbatches": m,
+                        "n_layers": n_layers,
                         "schedule": schedule, **vals})
             print(f"S={s} M={m:2d} {schedule:6s}: {vals['step_ms']:8.1f} ms  "
                   f"temp {vals['temp_bytes']/1e6:8.1f} MB  "

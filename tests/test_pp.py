@@ -140,16 +140,15 @@ def test_interleaved_matches_single_device(devices, n_stages, n_microbatches,
                                            n_chunks):
     """The virtual-stage schedule must still be the full-batch gradient.
 
-    Params go in through `interleave_blocks` (each stage's contiguous shard
-    holds its v non-contiguous chunks) and come back through
-    `deinterleave_blocks` for comparison in natural layer order."""
+    Params go in through `interleave_params` (each stage's contiguous shard
+    holds its v non-contiguous chunks, plus the layout tag) and come back
+    through `deinterleave_params` for comparison in natural layer order."""
     params, tokens = _params_and_tokens()
     optimizer = optax.sgd(0.1)
     ref_loss, ref_params = _reference_step(params, tokens, optimizer,
                                            n_microbatches)
 
-    inter = dict(params, blocks=pp.interleave_blocks(params["blocks"],
-                                                     n_stages, n_chunks))
+    inter = pp.interleave_params(params, n_stages, n_chunks)
     mesh = make_mesh({"stage": n_stages}, devices=devices[:n_stages])
     state = pp.init_state(mesh, inter, optimizer)
     step = pp.make_pipeline_step(CFG, optimizer, mesh, n_microbatches,
@@ -157,8 +156,7 @@ def test_interleaved_matches_single_device(devices, n_stages, n_microbatches,
     state, loss = step(state, pp.shard_batch(mesh, tokens))
 
     got = jax.device_get(state.params)
-    got = dict(got, blocks=pp.deinterleave_blocks(got["blocks"],
-                                                  n_stages, n_chunks))
+    got = pp.deinterleave_params(got, n_stages, n_chunks)
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_trees_close(got, jax.device_get(ref_params), 2e-5)
 
@@ -173,6 +171,31 @@ def test_interleave_blocks_roundtrip():
     wq = params["blocks"]["wq"]
     np.testing.assert_array_equal(np.asarray(inter["wq"][0]), np.asarray(wq[0]))
     np.testing.assert_array_equal(np.asarray(inter["wq"][1]), np.asarray(wq[2]))
+
+
+def test_interleaved_layout_guard(devices):
+    """Layout mistakes must fail loudly, not silently reorder layers:
+    natural params under schedule='interleaved', a (S, v) mismatch, and
+    tagged params under schedule='gpipe' all raise on the first step."""
+    params, tokens = _params_and_tokens()
+    optimizer = optax.sgd(0.1)
+    mesh = make_mesh({"stage": 2}, devices=devices[:2])
+    batch = pp.shard_batch(mesh, tokens)
+
+    step = pp.make_pipeline_step(CFG, optimizer, mesh, 2,
+                                 schedule="interleaved", n_chunks=2)
+    with pytest.raises(ValueError, match="interleave_params"):
+        step(pp.init_state(mesh, params, optimizer), batch)
+
+    wrong = pp.interleave_params(params, 2, 2)
+    step4 = pp.make_pipeline_step(CFG, optimizer, mesh, 2,
+                                  schedule="interleaved", n_chunks=4)
+    with pytest.raises(ValueError, match="different topology"):
+        step4(pp.init_state(mesh, wrong, optimizer), batch)
+
+    gpipe = pp.make_pipeline_step(CFG, optimizer, mesh, 2, schedule="gpipe")
+    with pytest.raises(ValueError, match="natural layer order"):
+        gpipe(pp.init_state(mesh, wrong, optimizer), batch)
 
 
 def test_interleaved_matches_single_device_s4(devices):
@@ -195,7 +218,7 @@ def test_interleaved_matches_single_device_s4(devices):
     updates, _ = optimizer.update(ref_grads, opt_state, params)
     ref_params = optax.apply_updates(params, updates)
 
-    inter = dict(params, blocks=pp.interleave_blocks(params["blocks"], 4, 2))
+    inter = pp.interleave_params(params, 4, 2)
     mesh = make_mesh({"stage": 4}, devices=devices[:4])
     state = pp.init_state(mesh, inter, optimizer)
     step = pp.make_pipeline_step(cfg, optimizer, mesh, n_microbatches=8,
@@ -203,6 +226,6 @@ def test_interleaved_matches_single_device_s4(devices):
     state, loss = step(state, pp.shard_batch(mesh, tokens))
 
     got = jax.device_get(state.params)
-    got = dict(got, blocks=pp.deinterleave_blocks(got["blocks"], 4, 2))
+    got = pp.deinterleave_params(got, 4, 2)
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_trees_close(got, jax.device_get(ref_params), 2e-5)
