@@ -66,6 +66,13 @@ class LlamaConfig:
     # experiments/attn_bench.py).
     attention_impl: str = "auto"
     flash_min_seq: int = 4096
+    # Stream flash-kernel operands in the dense [BH, Dh, T] layout instead of
+    # [BH, T, Dh]. At head dims below 128 lanes (this model's 48) the
+    # row-major layout pads every q/k/v/o and gradient transfer to 128 lanes
+    # — 2.67x the useful HBM bytes at Dh=48 — while dh-major is exactly
+    # dense. Same math and MXU shapes (ops/flash_attention.py); off until
+    # the on-chip measurement (experiments/attn_bench.py) says it wins.
+    flash_dh_major: bool = False
     # Dtype of the materialized [B·H, T, T] attention score tensor. The
     # default fp32 is what the PP/SP equivalence tests are calibrated to;
     # "bfloat16" halves the attention leg's dominant HBM tensor (softmax

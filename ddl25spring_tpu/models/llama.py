@@ -207,7 +207,8 @@ def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
         out = attn_fn(q, k, v)
     elif use_pallas:
         from ..ops.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True,
+                              dh_major=cfg.flash_dh_major)
     else:
         out = _xla_attention(q, k, v, causal=True,
                              softmax_dtype=cfg.softmax_dtype)

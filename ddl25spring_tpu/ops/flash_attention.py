@@ -304,6 +304,325 @@ def _bwd_kernels(qb, kb, vb, dob, lse, delta, causal: bool, block_q: int,
     return dq, dk, dv
 
 
+# ------------------------------------------------ dh-major ("packed") layout
+#
+# The kernels above stream [BH, T, Dh] blocks. At this model's Dh=48 the
+# minor dim is lane-padded to 128 in the TPU tiled layout, so every q/k/v/o
+# (and backward dq/dk/dv) HBM transfer moves 128/48 ≈ 2.67x the useful
+# bytes. Transposing the operands to [BH, Dh, T] makes them exactly dense —
+# Dh=48 is a whole number of f32/bf16 sublane tiles and T a lane multiple —
+# which converts the streamed traffic to 100% useful bytes. The MXU dots
+# keep the same shapes (K=Dh for QK is intrinsic to attention; no dense
+# packing can beat XLA's K-padding — a block-diagonal 2-head pack spends
+# exactly its saved padding on zero blocks), so this is a pure
+# memory-bandwidth play; scores are computed key-major ([bk, bq]) so the
+# softmax statistics live along lanes and never need a relayout.
+
+def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, block_q: int, block_k: int, n_k_blocks: int, scale: float,
+                  causal: bool, seq_len: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        qt = q_ref[0].astype(jnp.float32)                    # [dh, bq]
+        kt = k_ref[0].astype(jnp.float32)                    # [dh, bk]
+        vt = v_ref[0].astype(jnp.float32)                    # [dh, bk]
+        # Key-major scores: keys on sublanes, queries on lanes.
+        s = jax.lax.dot_general(kt, qt, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0) \
+            + ik * block_k
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1) \
+                + iq * block_q
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        else:
+            s = jnp.where(kpos < seq_len, s, _NEG_INF)
+
+        m_prev = m_ref[:1, :]                                # [1, bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [bk, bq]
+        alpha = jnp.exp(m_prev - m_new)                      # [1, bq]
+        l_new = alpha * l_ref[:1, :] + jnp.sum(p, axis=0, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            vt, p, preferred_element_type=jnp.float32)       # [dh, bq]
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == n_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:1, :]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(
+            jnp.broadcast_to(safe, lse_ref.shape[1:]))
+
+
+_SUBLANES = 8
+
+
+def _fwd_t(qb, kb, vb, causal: bool, block_q: int, block_k: int,
+           interpret: bool, seq_len: int, out_dtype):
+    """Forward on dh-major [BH, Dh, T_pad] inputs.
+
+    Returns (out [BH, Dh, T_pad], lse [BH, SUBLANES, T_pad] row-replicated).
+    """
+    bh, dh, t_pad = qb.shape
+    n_q = t_pad // block_q
+    n_k = t_pad // block_k
+    scale = 1.0 / math.sqrt(dh)
+
+    kernel = functools.partial(
+        _fwd_kernel_t, block_q=block_q, block_k=block_k, n_k_blocks=n_k,
+        scale=scale, causal=causal, seq_len=seq_len)
+    if causal:
+        def kv_index(bh_, iq, ik):
+            return (bh_, 0,
+                    jnp.minimum(ik, (iq * block_q + block_q - 1) // block_k))
+    else:
+        def kv_index(bh_, iq, ik):
+            return (bh_, 0, ik)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, dh, block_q), lambda bh_, iq, ik: (bh_, 0, iq)),
+            pl.BlockSpec((1, dh, block_k), kv_index),
+            pl.BlockSpec((1, dh, block_k), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dh, block_q), lambda bh_, iq, ik: (bh_, 0, iq)),
+            pl.BlockSpec((1, _SUBLANES, block_q),
+                         lambda bh_, iq, ik: (bh_, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, dh, t_pad), out_dtype),
+            jax.ShapeDtypeStruct((bh, _SUBLANES, t_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_SUBLANES, block_q), jnp.float32),    # m
+            pltpu.VMEM((_SUBLANES, block_q), jnp.float32),    # l
+            pltpu.VMEM((dh, block_q), jnp.float32),           # acc
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+
+def _bwd_mask_t(iq, ik, block_q: int, block_k: int, causal: bool,
+                seq_len: int):
+    """[bk, bq] validity mask (key-major twin of _bwd_mask)."""
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0) \
+        + ik * block_k
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1) \
+        + iq * block_q
+    mask = qpos < seq_len
+    if causal:
+        mask &= qpos >= kpos
+    else:
+        mask &= kpos < seq_len
+    return mask
+
+
+def _bwd_p_ds_t(qt, kt, vt, dot_, lse_row, delta_row, iq, ik, *, block_q,
+                block_k, scale, causal, seq_len):
+    """Key-major recompute: pT [bk, bq] and dsT [bk, bq]."""
+    s = jax.lax.dot_general(kt, qt, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _bwd_mask_t(iq, ik, block_q, block_k, causal, seq_len)
+    p = jnp.where(mask, jnp.exp(s - lse_row), 0.0)           # [bk, bq]
+    dp = jax.lax.dot_general(vt, dot_, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_row) * scale                        # [bk, bq]
+    return p, ds
+
+
+def _dq_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                 dq_acc, *, block_q: int, block_k: int, n_k_blocks: int,
+                 scale: float, causal: bool, seq_len: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        qt = q_ref[0].astype(jnp.float32)
+        kt = k_ref[0].astype(jnp.float32)
+        vt = v_ref[0].astype(jnp.float32)
+        dot_ = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_p_ds_t(qt, kt, vt, dot_, lse_ref[0][:1, :],
+                            delta_ref[0][:1, :], iq, ik, block_q=block_q,
+                            block_k=block_k, scale=scale, causal=causal,
+                            seq_len=seq_len)
+        dq_acc[:] += jax.lax.dot(kt, ds,
+                                 preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                  dv_ref, dk_acc, dv_acc, *, block_q: int, block_k: int,
+                  n_q_blocks: int, scale: float, causal: bool, seq_len: int):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _body():
+        qt = q_ref[0].astype(jnp.float32)
+        kt = k_ref[0].astype(jnp.float32)
+        vt = v_ref[0].astype(jnp.float32)
+        dot_ = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds_t(qt, kt, vt, dot_, lse_ref[0][:1, :],
+                            delta_ref[0][:1, :], iq, ik, block_q=block_q,
+                            block_k=block_k, scale=scale, causal=causal,
+                            seq_len=seq_len)
+        dv_acc[:] += jax.lax.dot_general(
+            dot_, p, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [dh, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            qt, ds, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [dh, bk]
+
+    @pl.when(iq == n_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_kernels_t(qb, kb, vb, dob, lse, delta, causal: bool, block_q: int,
+                   block_k: int, interpret: bool, seq_len: int):
+    """dQ and dK/dV kernels on dh-major [BH, Dh, T_pad] inputs."""
+    bh, dh, t_pad = qb.shape
+    n_q = t_pad // block_q
+    n_k = t_pad // block_k
+    scale = 1.0 / math.sqrt(dh)
+    common = dict(block_q=block_q, block_k=block_k, scale=scale,
+                  causal=causal, seq_len=seq_len)
+
+    q_spec = pl.BlockSpec((1, dh, block_q), lambda bh_, iq, ik: (bh_, 0, iq))
+    row_spec = pl.BlockSpec((1, _SUBLANES, block_q),
+                            lambda bh_, iq, ik: (bh_, 0, iq))
+    if causal:
+        def kv_index(bh_, iq, ik):
+            return (bh_, 0,
+                    jnp.minimum(ik, (iq * block_q + block_q - 1) // block_k))
+    else:
+        def kv_index(bh_, iq, ik):
+            return (bh_, 0, ik)
+    kv_spec = pl.BlockSpec((1, dh, block_k), kv_index)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel_t, n_k_blocks=n_k, **common),
+        grid=(bh, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, dh, t_pad), qb.dtype),
+        scratch_shapes=[pltpu.VMEM((dh, block_q), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    if causal:
+        def q_index(bh_, ik, iq):
+            return (bh_, 0, jnp.maximum(iq, (ik * block_k) // block_q))
+    else:
+        def q_index(bh_, ik, iq):
+            return (bh_, 0, iq)
+    q_spec_t = pl.BlockSpec((1, dh, block_q), q_index)
+    row_spec_t = pl.BlockSpec((1, _SUBLANES, block_q), q_index)
+    kv_spec_t = pl.BlockSpec((1, dh, block_k),
+                             lambda bh_, ik, iq: (bh_, 0, ik))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel_t, n_q_blocks=n_q, **common),
+        grid=(bh, n_k, n_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct((bh, dh, t_pad), kb.dtype),
+                   jax.ShapeDtypeStruct((bh, dh, t_pad), vb.dtype)],
+        scratch_shapes=[pltpu.VMEM((dh, block_k), jnp.float32),
+                        pltpu.VMEM((dh, block_k), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+    return dq, dk, dv
+
+
+def _layout_t(x, t_pad: int):
+    """[B, T, H, Dh] -> [B*H, Dh, T_pad] (dense dh-major kernel layout)."""
+    b, t, h, dh = x.shape
+    x = jnp.transpose(x, (0, 2, 3, 1)).reshape(b * h, dh, t)
+    if t_pad != t:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, t_pad - t)))
+    return x
+
+
+def _unlayout_t(x, b: int, t: int):
+    """[B*H, Dh, T_pad] -> [B, T, H, Dh]."""
+    bh, dh, _ = x.shape
+    return jnp.transpose(x[:, :, :t].reshape(b, bh // b, dh, t), (0, 3, 1, 2))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_t(q, k, v, causal: bool, block_q: int, block_k: int,
+             interpret: bool):
+    out, _ = _flash_t_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_t_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, dh = q.shape
+    t_pad = _pad_len(t, block_q, block_k)
+    out, lse = _fwd_t(_layout_t(q, t_pad), _layout_t(k, t_pad),
+                      _layout_t(v, t_pad), causal, block_q, block_k,
+                      interpret, t, q.dtype)
+    return _unlayout_t(out, b, t), (q, k, v, _unlayout_t(out, b, t),
+                                    lse[:, :1, :])
+
+
+def _flash_t_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, dh = q.shape
+    t_pad = _pad_len(t, block_q, block_k)
+    lse = jnp.broadcast_to(lse, (b * h, _SUBLANES, t_pad))
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.moveaxis(delta, 2, 1).reshape(b * h, t)       # [BH, T]
+    delta = jnp.pad(delta, ((0, 0), (0, t_pad - t)))
+    delta = jnp.broadcast_to(delta[:, None, :], (b * h, _SUBLANES, t_pad))
+    dq, dk, dv = _bwd_kernels_t(
+        _layout_t(q, t_pad), _layout_t(k, t_pad), _layout_t(v, t_pad),
+        _layout_t(g, t_pad), lse, delta, causal, block_q, block_k, interpret,
+        t)
+    return (_unlayout_t(dq, b, t), _unlayout_t(dk, b, t),
+            _unlayout_t(dv, b, t))
+
+
+_flash_t.defvjp(_flash_t_fwd, _flash_t_bwd)
+
+
 # --------------------------------------------------- custom_vjp + public API
 
 def _layout(x, t_pad: int):
@@ -367,18 +686,27 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "dh_major"))
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None
-                    ) -> jnp.ndarray:
+                    block_k: int = 128, interpret: bool | None = None,
+                    dh_major: bool = False) -> jnp.ndarray:
     """Fused attention, differentiable. q, k, v: [B, T, H, Dh] (same layout
     as the XLA path in models/llama.attention). Returns [B, T, H, Dh].
 
     Sequence length is padded up to a block multiple internally; padded keys
     get zero softmax mass and padded query rows are trimmed on return (and
     zeroed in the backward).
+
+    ``dh_major=True`` streams operands in the [BH, Dh, T] layout, which is
+    exactly dense on TPU for head dims like this model's 48 (a [_, T, 48]
+    operand is lane-padded to 128, costing 2.67x HBM bytes on every q/k/v/o
+    and gradient transfer). Same math, same MXU shapes — a pure
+    memory-bandwidth variant; see experiments/attn_bench.py for the
+    measured comparison.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if dh_major:
+        return _flash_t(q, k, v, causal, block_q, block_k, interpret)
     return _flash(q, k, v, causal, block_q, block_k, interpret)

@@ -58,8 +58,21 @@ def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
         k = jax.random.normal(ks[1], (b, t, h, dh), jnp.bfloat16)
         v = jax.random.normal(ks[2], (b, t, h, dh), jnp.bfloat16)
         row: Dict[str, float] = {}
-        for name, fn in (("xla", lambda q, k, v: _xla_attention(q, k, v, causal=True)),
-                         ("flash", lambda q, k, v: flash_attention(q, k, v, causal=True))):
+        variants = (
+            ("xla", lambda q, k, v: _xla_attention(q, k, v, causal=True)),
+            ("flash", lambda q, k, v: flash_attention(q, k, v, causal=True)),
+            # dh-major: dense [BH, Dh, T] operand layout — the head-packing
+            # lever for Dh=48 (lane padding costs the row-major kernels
+            # 2.67x HBM bytes per q/k/v/o transfer).
+            ("flash_dhm", lambda q, k, v: flash_attention(
+                q, k, v, causal=True, dh_major=True)),
+            # Whole-sequence blocks at T<=512: one grid step per (b, h),
+            # no online-softmax recurrence.
+            ("flash_dhm_wide", lambda q, k, v: flash_attention(
+                q, k, v, causal=True, dh_major=True,
+                block_q=min(q.shape[1], 512), block_k=min(q.shape[1], 512))),
+        )
+        for name, fn in variants:
             fwd = jax.jit(fn)
             fb = jax.jit(jax.grad(
                 lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
@@ -70,9 +83,12 @@ def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
                "platform": platform, **{k2: round(v2, 3) for k2, v2 in row.items()}}
         sink.write(rec)
         results[f"b{b}_t{t}"] = row
-        print(f"B={b:3d} T={t:5d}: xla f+b {row['xla_fwdbwd_ms']:8.2f} ms   "
-              f"flash f+b {row['flash_fwdbwd_ms']:8.2f} ms   "
-              f"({'flash' if row['flash_fwdbwd_ms'] < row['xla_fwdbwd_ms'] else 'xla'} wins)")
+        fb = {n: ms for n, ms in row.items() if n.endswith("_fwdbwd_ms")}
+        winner = min(fb, key=fb.get).replace("_fwdbwd_ms", "")
+        print(f"B={b:3d} T={t:5d}: " +
+              "   ".join(f"{n.replace('_fwdbwd_ms', '')} f+b {ms:8.2f} ms"
+                         for n, ms in fb.items()) +
+              f"   ({winner} wins)", flush=True)
     print(f"-> {sink.path} [{platform}]")
     return results
 

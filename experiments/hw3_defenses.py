@@ -169,7 +169,7 @@ def complete_bulyan(n_train: int = 6000, n_test: int = 2000,
         partial = {kb for kb, c in counts.items() if c < rounds}
         if partial:
             keep = ~cells.set_index(["_k", "_b"]).index.isin(partial)
-            df[keep.values].to_csv(path, index=False)
+            df[keep].to_csv(path, index=False)
             print(f"dropped partial cells {sorted(partial)}", flush=True)
         n_train = int(df["n_train"].iloc[0])  # match the committed run
         if "n_test" in df.columns and df["n_test"].notna().any():

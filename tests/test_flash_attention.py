@@ -99,6 +99,64 @@ def test_llama_forward_with_pallas_attention():
                                atol=5e-2, rtol=5e-2)
 
 
+# ------------------------------------------------------- dh-major variant
+
+@pytest.mark.parametrize("t,dh,causal", [(256, 48, True), (128, 64, False),
+                                         (100, 32, True), (100, 32, False)])
+def test_flash_dh_major_matches_xla(t, dh, causal):
+    """The [BH, Dh, T] dense-layout kernels are the same math — including
+    padded tails (non-block-multiple t) on the lane axis."""
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    b, h = 2, 3
+    q = jax.random.normal(kq, (b, t, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, dh), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                          dh_major=True)
+    ref = _ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_dh_major_bf16():
+    kq, kk, kv = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(kq, (1, 256, 2, 48), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, 256, 2, 48), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, 256, 2, 48), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, dh_major=True)
+    ref = _ref_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("t,causal", [(128, True), (100, False)])
+def test_flash_dh_major_grad_matches_xla(t, causal):
+    """dQ/dK/dV through the dh-major backward kernels, incl. padded query
+    lanes (must backprop zeros)."""
+    kq, kk, kv, kw = jax.random.split(jax.random.key(8), 4)
+    b, h, dh = 2, 2, 48
+    q = jax.random.normal(kq, (b, t, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, dh), jnp.float32)
+    w = jax.random.normal(kw, (b, t, h, dh), jnp.float32)
+
+    def loss(impl):
+        def f(q, k, v):
+            if impl == "pallas":
+                o = flash_attention(q, k, v, causal=causal, block_q=64,
+                                    block_k=64, dh_major=True)
+            else:
+                o = _ref_attention(q, k, v, causal=causal)
+            return jnp.sum(o.astype(jnp.float32) * w)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for gf, gr, name in zip(loss("pallas"), loss("xla"), "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4, err_msg=f"d{name}")
+
+
 # ------------------------------------------------------------ backward pass
 
 def _loss_pair(t, dh, causal, dtype=jnp.float32, seed=7):
@@ -209,6 +267,8 @@ def test_flash_on_real_tpu_smoke():
         "out = flash_attention(*qkv, causal=True)\n"
         "ref = llama._xla_attention(*qkv, causal=True)\n"
         "assert float(jnp.abs(out - ref).max()) < 5e-2\n"
+        "out_t = flash_attention(*qkv, causal=True, dh_major=True)\n"
+        "assert float(jnp.abs(out_t - ref).max()) < 5e-2\n"
         "gf = jax.grad(lambda q, k, v: jnp.sum(\n"
         "    flash_attention(q, k, v, causal=True) * w), (0, 1, 2))(*qkv)\n"
         "gr = jax.grad(lambda q, k, v: jnp.sum(\n"
