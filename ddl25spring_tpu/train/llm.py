@@ -21,7 +21,7 @@ import optax
 from ..config import LlamaConfig, TrainConfig
 from ..data.tokens import sharded_batches
 from ..models import llama
-from ..parallel import dp, make_mesh
+from ..parallel import dp, make_mesh, pp
 from ..tokenizers import load_tokenizer
 
 
@@ -128,4 +128,71 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         report.wall_time = time.perf_counter() - t_start
         timed_steps = train_cfg.iters - start_step - warmup_steps_excluded
         report.tokens_per_sec = tokens_per_step * timed_steps / report.wall_time
+    return report
+
+
+def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
+                 train_cfg: Optional[TrainConfig] = None, *,
+                 mesh=None,
+                 tokenizer=None,
+                 schedule: str = "gpipe",
+                 log_every: int = 100,
+                 log_fn: Callable[[str], None] = print,
+                 warmup_steps_excluded: int = 2) -> LLMTrainReport:
+    """Pipeline(-x-data)-parallel tiny-Llama training; returns losses and
+    throughput.
+
+    Capability target: the reference's 3-stage microbatched pipeline run
+    (lab/hw01/homework 1 b/homework_1_b1.py, committed log out_b1_2.txt:
+    loss 10.517 -> ~6.0 over 5000 iters) and the 2-pipeline x 3-stage DPxPP
+    topology (homework_1_b2.py, out_b2_*.txt). ``train_cfg.stage``/
+    ``train_cfg.data``/``train_cfg.microbatches`` pick the topology; each
+    data shard reads a disjoint stream window (shard_skip=5000), matching
+    the reference's per-pipeline data offset.
+
+    The loop mirrors train_llm_dp's timing/throughput accounting but
+    deliberately omits its checkpoint/resume plumbing (orbax restore +
+    stream replay) — add it here if pipeline runs ever need resume; keep
+    the two loops' timing semantics in sync when touching either.
+    """
+    tok = tokenizer or load_tokenizer()
+    model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
+    train_cfg = train_cfg or TrainConfig()
+    mesh = mesh or make_mesh({"data": train_cfg.data,
+                              "stage": train_cfg.stage})
+    n_data = mesh.shape.get("data", 1)
+
+    params = llama.init_llama(jax.random.key(train_cfg.seed), model_cfg)
+    optimizer = optax.adam(train_cfg.lr)
+    if schedule == "interleaved":
+        params = pp.interleave_params(params, mesh.shape["stage"],
+                                      n_chunks=2)
+    state = pp.init_state(mesh, params, optimizer)
+    step_fn = pp.make_pipeline_step(model_cfg, optimizer, mesh,
+                                    n_microbatches=train_cfg.microbatches,
+                                    schedule=schedule)
+
+    batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
+                              n_data, shard_skip=5000, seed=train_cfg.seed)
+
+    report = LLMTrainReport()
+    tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
+    t_start = None
+    device_losses = []
+    for it in range(train_cfg.iters):
+        host_batch = next(batches).reshape(
+            n_data * train_cfg.batch_size, train_cfg.seq_len)
+        state, loss = step_fn(state, pp.shard_batch(mesh, host_batch))
+        if it + 1 == warmup_steps_excluded:
+            float(loss)  # hard sync before starting the timer
+            t_start = time.perf_counter()
+        device_losses.append(loss)
+        if log_every and it % log_every == 0:
+            log_fn(f"iter {it}: loss {float(loss):.4f}")
+    report.losses = [float(l) for l in device_losses]
+    report.steps = train_cfg.iters
+    if t_start is not None and train_cfg.iters > warmup_steps_excluded:
+        report.wall_time = time.perf_counter() - t_start
+        timed = train_cfg.iters - warmup_steps_excluded
+        report.tokens_per_sec = tokens_per_step * timed / report.wall_time
     return report

@@ -87,7 +87,47 @@ def main(quick: bool = False, n_train: int = 60000, n_test: int = 10000
     return finals
 
 
+def matched_shards(n_test: int = 2000, rounds: int = 10) -> Dict:
+    """Append the FedAvg N-sweep at the reference's per-client shard sizes.
+
+    The committed CPU run shrinks the corpus to 12,000 rows, which starves
+    high-N FedAvg clients to ~1 local step per round and collapses the
+    N-scaling signature (VERDICT r03 weak #2). Per the measured
+    accuracy-vs-steps curve of the synthetic generator, the signature is a
+    shard-size effect, not a generator effect — so this runs ONLY the three
+    FedAvg C=0.1 rows at the full n_train=60,000 (600–6,000 rows/client,
+    exactly the reference's shard sizes) and appends them, labeled by their
+    n_train column, next to the 12k battery.
+    """
+    import os
+
+    from ddl25spring_tpu.utils.tracing import ResultSink
+
+    sink = ResultSink(os.path.join(common.RESULTS_DIR, "hw1_fl.csv"))
+    provenance = common.mnist_provenance()
+    finals = {}
+    for n in (10, 50, 100):
+        cfg = FLConfig(nr_clients=n, client_fraction=0.1, rounds=rounds)
+        acc = run_one(FedAvgServer, cfg, sink, provenance,
+                      n_train=60000, n_test=n_test)
+        finals[("fedavg-60k", n, 0.1)] = acc
+        print(f"fedavg N={n:3d} C=0.10 n_train=60000: final acc {acc:.4f}",
+              flush=True)
+    return finals
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    main(quick=ap.parse_args().quick)
+    ap.add_argument("--matched-shards", action="store_true",
+                    help="append the FedAvg rows at reference shard sizes")
+    ap.add_argument("--cpu", action="store_true")
+    a = ap.parse_args()
+    if a.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if a.matched_shards:
+        matched_shards()
+    else:
+        main(quick=a.quick)

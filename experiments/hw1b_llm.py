@@ -19,34 +19,96 @@ import argparse
 from typing import Dict
 
 from ddl25spring_tpu.config import LlamaConfig, TrainConfig
-from ddl25spring_tpu.train.llm import train_llm_dp
 
 from . import common
 
+# The reference's committed-run topologies (config label -> TrainConfig
+# fields). b1 = 3-stage microbatched PP (out_b1_2.txt: batch 3 in
+# microbatches of 1); b2 = 2 pipelines x 3 stages with the second
+# pipeline's stream offset (out_b2_*.txt).
+CONFIGS = {
+    "dp1": dict(data=1, stage=1),
+    "pp3": dict(data=1, stage=3, microbatches=3),
+    "dp2_pp3": dict(data=2, stage=3, microbatches=3),
+}
 
-def main(quick: bool = False, iters: int = 5000) -> Dict[str, float]:
+
+def _run_config(name: str, iters: int, sink, provenance: str
+                ) -> Dict[str, float]:
+    from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
+
+    topo = CONFIGS[name]
+    train_cfg = TrainConfig(iters=iters, **topo)  # batch 3/shard, Adam 8e-4
+    model_cfg = LlamaConfig(dtype="bfloat16")
+    label = f"{name}_b{train_cfg.data * train_cfg.batch_size}_seq256_adam8e-4"
+    log_every = max(1, iters // 10)
+    if topo["stage"] > 1:
+        report = train_llm_pp(model_cfg, train_cfg, log_every=log_every)
+    else:
+        report = train_llm_dp(model_cfg, train_cfg, log_every=log_every)
+    for it in range(0, len(report.losses), 10):
+        sink.write({"iter": it, "loss": report.losses[it], "data": provenance,
+                    "config": label})
+    sink.write({"iter": len(report.losses) - 1, "loss": report.losses[-1],
+                "data": provenance, "config": label})
+    print(f"{name}: loss {report.losses[0]:.3f} -> {report.losses[-1]:.3f} "
+          f"over {iters} iters ({report.tokens_per_sec:.0f} tok/s) "
+          f"[{provenance}]", flush=True)
+    return {f"{name}_first": report.losses[0],
+            f"{name}_last": report.losses[-1],
+            f"{name}_tokens_per_sec": report.tokens_per_sec}
+
+
+def main(quick: bool = False, iters: int = 5000,
+         configs=("dp1",), append: bool = False) -> Dict[str, float]:
+    """``configs`` picks topologies from CONFIGS; the multi-device ones need
+    >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
+    on a single real chip, and the pipeline rows are appended by
+    ``python -m experiments.hw1b_llm --configs pp3 dp2_pp3 --append``."""
+    import os
+
+    from ddl25spring_tpu.utils.tracing import ResultSink
+
     provenance = common.tinystories_provenance()
     if quick:
         iters = 50
-    sink = common.sink("hw1b_llm_loss.csv")
-    train_cfg = TrainConfig(iters=iters)  # batch 3, seq 256, Adam 8e-4
-    model_cfg = LlamaConfig(dtype="bfloat16")
-    report = train_llm_dp(model_cfg, train_cfg, log_every=max(1, iters // 10))
-    for it in range(0, len(report.losses), 10):
-        sink.write({"iter": it, "loss": report.losses[it], "data": provenance,
-                    "config": "dp1_b3_seq256_adam8e-4"})
-    sink.write({"iter": len(report.losses) - 1, "loss": report.losses[-1],
-                "data": provenance, "config": "dp1_b3_seq256_adam8e-4"})
-    print(f"loss {report.losses[0]:.3f} -> {report.losses[-1]:.3f} over "
-          f"{iters} iters ({report.tokens_per_sec:.0f} tok/s) [{provenance}]")
+    if append:
+        sink = ResultSink(os.path.join(common.RESULTS_DIR,
+                                       "hw1b_llm_loss.csv"))
+    else:
+        sink = common.sink("hw1b_llm_loss.csv")
+    out: Dict[str, float] = {}
+    for name in configs:
+        out.update(_run_config(name, iters, sink, provenance))
     print(f"-> {sink.path}")
-    return {"first": report.losses[0], "last": report.losses[-1],
-            "tokens_per_sec": report.tokens_per_sec}
+    # run_all compatibility: single-config calls keep the old summary keys.
+    if len(configs) == 1:
+        n = configs[0]
+        out = {"first": out[f"{n}_first"], "last": out[f"{n}_last"],
+               "tokens_per_sec": out[f"{n}_tokens_per_sec"]}
+    return out
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--iters", type=int, default=5000)
+    ap.add_argument("--configs", nargs="*", default=["dp1"],
+                    choices=sorted(CONFIGS))
+    ap.add_argument("--append", action="store_true",
+                    help="append to the committed CSV instead of replacing")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin CPU and force enough virtual devices for the "
+                         "multi-stage configs")
     a = ap.parse_args()
-    main(quick=a.quick, iters=a.iters)
+    if a.cpu:
+        import os
+
+        os.environ.setdefault("XLA_FLAGS", "")
+        if "host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+            os.environ["XLA_FLAGS"] += \
+                " --xla_force_host_platform_device_count=8"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append)

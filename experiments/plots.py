@@ -49,8 +49,10 @@ def plot_fl_curves(csv_name: str, out_name: Optional[str] = None,
     return out
 
 
-def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None
-                    ) -> Optional[str]:
+def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None,
+                    group_col: Optional[str] = None) -> Optional[str]:
+    """``group_col`` (e.g. hw1b's ``config``) draws one line per group —
+    multi-topology CSVs would otherwise render as one zigzag polyline."""
     import pandas as pd
     path = os.path.join(common.RESULTS_DIR, csv_name)
     if not os.path.exists(path):
@@ -58,9 +60,13 @@ def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None
     df = pd.read_csv(path)
     plt = _mpl()
     fig, ax = plt.subplots(figsize=(7, 4.5))
-    for yc in ys:
-        if yc in df.columns:
-            ax.plot(df[x], df[yc], label=yc)
+    groups = (df.groupby(group_col)
+              if group_col and group_col in df.columns else [(None, df)])
+    for gname, g in groups:
+        for yc in ys:
+            if yc in g.columns:
+                label = yc if gname is None else f"{gname}"
+                ax.plot(g[x], g[yc], label=label)
     ax.set_xlabel(x)
     ax.set_ylabel("loss")
     ax.set_title(csv_name.replace(".csv", ""))
@@ -75,12 +81,15 @@ def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None
 
 def main() -> list:
     made = [
-        plot_fl_curves("hw1_fl.csv"),
+        # n_train separates the 12k battery from matched-shard 60k appends.
+        plot_fl_curves("hw1_fl.csv",
+                       group_cols=("algorithm", "N", "C", "n_train")),
         plot_fl_curves("hw3_defenses.csv",
                        group_cols=("defense", "iid")),
         plot_fl_curves("hw3_bulyan.csv", group_cols=("k", "beta")),
         plot_fl_curves("hw3_sparsefed.csv", group_cols=("topk_fraction",)),
-        plot_loss_curve("hw1b_llm_loss.csv", "iter", ["loss"]),
+        plot_loss_curve("hw1b_llm_loss.csv", "iter", ["loss"],
+                        group_col="config"),
         plot_loss_curve("hw2_vfl_vae.csv", "epoch", ["total", "recon", "kl"]),
     ]
     made = [m for m in made if m]

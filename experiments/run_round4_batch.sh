@@ -1,0 +1,30 @@
+#!/bin/sh
+# Round-4 evidence chain (single CPU core — jobs must serialize):
+#   1. FedAvg at reference shard sizes (hw1_fl --matched-shards)
+#   2. pp_schedules rerun (adds the 16-layer 8-stage interleaved row)
+#   3. hw1b pipeline-topology loss curves (pp3 1000 iters, dp2_pp3 600)
+#   4. plots + PARITY.md regeneration
+# Each stage appends/owns its CSV; a kill between stages loses only the
+# stage in flight. Logs to experiments/results/round4_batch.log.
+set -x
+cd "$(dirname "$0")/.."
+LOG=experiments/results/round4_batch.log
+{
+  echo "=== matched shards $(date) ==="
+  python -m experiments.hw1_fl --matched-shards --cpu
+  echo "=== pp_schedules $(date) ==="
+  python -m experiments.pp_schedules
+  echo "=== hw1b pp3 $(date) ==="
+  python -m experiments.hw1b_llm --iters 1000 --configs pp3 --append --cpu
+  echo "=== hw1b dp2_pp3 $(date) ==="
+  python -m experiments.hw1b_llm --iters 600 --configs dp2_pp3 --append --cpu
+  echo "=== plots + parity $(date) ==="
+  python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+from experiments import plots, parity_report
+plots.main()
+parity_report.main()
+EOF
+  echo "=== done $(date) ==="
+} > "$LOG" 2>&1

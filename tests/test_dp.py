@@ -81,6 +81,27 @@ def test_dp_loss_decreases_end_to_end(devices):
     assert report.tokens_per_sec > 0
 
 
+def test_train_llm_pp_matches_dp(devices):
+    """The pipeline training driver must walk the same loss trajectory as
+    the DP driver on the identical stream/seed (the PP step is the same
+    gradient — tests/test_pp.py proves it at the step level; this pins the
+    driver plumbing: stream windows, microbatching, mesh wiring)."""
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=32, num_heads=4, n_layers=2,
+                      ctx_size=32)
+    base = dict(batch_size=4, seq_len=32, iters=8, lr=3e-3)
+    ref = train_llm_dp(cfg, TrainConfig(**base), tokenizer=ByteTokenizer(),
+                       mesh=make_mesh({"data": 1}, devices=devices[:1]),
+                       log_every=0)
+    pp_mesh = make_mesh({"data": 1, "stage": 2}, devices=devices[:2])
+    got = train_llm_pp(cfg, TrainConfig(**base, stage=2, microbatches=2),
+                       tokenizer=ByteTokenizer(), mesh=pp_mesh, log_every=0)
+    np.testing.assert_allclose(got.losses, ref.losses, atol=2e-4, rtol=2e-4)
+    assert got.tokens_per_sec > 0
+
+
 def test_zero1_matches_grad_aggregation(devices):
     """ZeRO-1 sharded-optimizer DP computes the same training trajectory as
     plain gradient-aggregation DP (Adam is elementwise, so slicing the flat
