@@ -1,0 +1,78 @@
+"""Sequence-parallel (ring attention) memory scaling evidence.
+
+The reference fixes sequence length at 256 on one device (lab/tutorial_1b/
+primer/intro.py:10); long context is a capability this framework adds
+(parallel/sp.py). Wall-clock on the virtual CPU mesh is meaningless, but the
+XLA-compiled per-device temp-buffer size from ``compiled.memory_analysis()``
+is hardware-independent — the same methodology as experiments/pp_schedules.
+This sweeps ring size n_seq at fixed global sequence length and records the
+per-device temp bytes of the full train step: ring attention's point is that
+activations (and the per-hop [T/n, T/n] score blocks) shrink with the ring,
+so context scales linearly in devices.
+
+Results → ``experiments/results/sp_bench.csv``. Run:
+    python -m experiments.sp_bench        (pins CPU + 8 virtual devices)
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict
+
+
+def measure(seq_len: int, n_seq: int, *, batch: int = 2) -> Dict[str, float]:
+    import jax
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import make_mesh, sp
+
+    # Small width, long sequence: the quantities under test scale with T.
+    cfg = LlamaConfig(vocab_size=512, dmodel=64, num_heads=4, n_layers=4,
+                      ctx_size=seq_len)
+    devices = jax.devices()[:n_seq]
+    mesh = make_mesh({"seq": n_seq}, devices=devices)
+    optimizer = optax.sgd(0.1)
+    params = llama.init_llama(jax.random.key(0), cfg)
+    state = sp.init_state(mesh, params, optimizer)
+    step = sp.make_sp_train_step(cfg, optimizer, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq_len), 0,
+                                cfg.vocab_size)
+    compiled = step.lower(state, sp.shard_batch(mesh, tokens)).compile()
+    mem = compiled.memory_analysis()
+    return {"temp_bytes": float(getattr(mem, "temp_size_in_bytes", 0) or 0),
+            "argument_bytes": float(
+                getattr(mem, "argument_size_in_bytes", 0) or 0)}
+
+
+def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
+    from . import common
+
+    sink = common.sink("sp_bench.csv")
+    grid = [(2048, (1, 2, 4))] if quick else [(2048, (1, 2, 4, 8)),
+                                              (8192, (1, 2, 4, 8))]
+    results: Dict[str, Dict[str, float]] = {}
+    for seq_len, rings in grid:
+        for n in rings:
+            vals = measure(seq_len, n)
+            sink.write({"seq_len": seq_len, "n_seq": n, **vals})
+            results[f"t{seq_len}_n{n}"] = vals
+            print(f"T={seq_len:5d} ring={n}: per-device temp "
+                  f"{vals['temp_bytes']/1e6:9.1f} MB", flush=True)
+    print(f"-> {sink.path}")
+    return results
+
+
+if __name__ == "__main__":
+    import os
+
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(quick=ap.parse_args().quick)

@@ -67,6 +67,45 @@ def test_hw3_defense_hooks_resolve():
         _defense_hook("unknown", 2)
 
 
+def test_complete_bulyan_partial_cell_drop(tmp_path, monkeypatch):
+    """The resume path must treat a truncated cell as missing: drop its
+    rows and re-run it whole, and never re-run a complete cell."""
+    import pandas as pd
+
+    from experiments import common, hw3_defenses
+
+    monkeypatch.setattr(common, "RESULTS_DIR", str(tmp_path))
+    rows = []
+    for k, beta, n in [(10, 0.2, 3), (14, 0.4, 1)]:  # complete vs partial
+        for r in range(1, n + 1):
+            rows.append(dict(k=k, beta=beta, round=r, test_accuracy=0.1 * r,
+                             n_train=100, n_test=50))
+    rows = pd.DataFrame(rows)
+    # make k=10/0.2 complete at rounds=3, leave k=14/0.4 partial
+    path = tmp_path / "hw3_bulyan.csv"
+    rows.to_csv(path, index=False)
+
+    ran = []
+    monkeypatch.setattr(
+        hw3_defenses, "run_one",
+        lambda defense, iid, sink, prov, **kw: ran.append(
+            (kw["extra"]["k"], kw["extra"]["beta"])) or 0.5)
+    hw3_defenses.complete_bulyan(rounds=3)
+    # complete cell skipped, partial cell re-run, all other grid cells run
+    assert (10, 0.2) not in ran
+    assert (14, 0.4) in ran
+    assert len(ran) == 8
+    left = pd.read_csv(path)
+    assert len(left[(left["k"] == 14) & (left["beta"] == 0.4)]) == 0
+
+
+def test_hw1b_configs_cover_reference_topologies():
+    from experiments.hw1b_llm import CONFIGS
+
+    assert CONFIGS["pp3"] == dict(data=1, stage=3, microbatches=3)
+    assert CONFIGS["dp2_pp3"] == dict(data=2, stage=3, microbatches=3)
+
+
 def test_parity_report_renders_from_committed_results():
     from experiments import parity_report
 
