@@ -158,8 +158,10 @@ def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
 def main():
     import dataclasses
     base = LlamaConfig(dtype="bfloat16")  # canonical 288/6/6, bf16 compute
-    best = (None, None, 0.0)              # (batch, variant, total tokens/s)
-    n_dev = 1
+    # (batch, variant, total tokens/s, device count that measured it) —
+    # the child's n_dev can differ from the parent's on this flaky tunnel,
+    # so every point carries the count its own process saw.
+    best = (None, None, 0.0, 1)
 
     if PLATFORM not in (None, "cpu"):
         # The pallas dh-major variant (the head-packing lever for Dh=48,
@@ -174,16 +176,16 @@ def main():
                            "flash_dh_major": True}
         for bs in (32, 64, 128):
             try:
-                tps, n_dev = _time_batch_subprocess(flash_overrides, bs,
-                                                    timeout=600)
+                tps, child_ndev = _time_batch_subprocess(
+                    flash_overrides, bs, timeout=600)
             except Exception as e:
                 print(f"batch {bs:4d} attn=flash-dhm : failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr)
                 continue
-            print(f"batch {bs:4d} attn=flash-dhm : {tps/n_dev:12.0f} "
+            print(f"batch {bs:4d} attn=flash-dhm : {tps/child_ndev:12.0f} "
                   f"tok/s/chip", file=sys.stderr)
-            if tps > best[2]:
-                best = (bs, "flash-dhm", tps)
+            if tps / child_ndev > best[2] / best[3]:
+                best = (bs, "flash-dhm", tps, child_ndev)
 
     n_dev = len(jax.devices())            # initializes this process's backend
     mesh = make_mesh({"data": n_dev})
@@ -214,17 +216,17 @@ def main():
                 continue
             print(f"batch {bs:4d} attn={label:10s}: {tps/n_dev:12.0f} "
                   f"tok/s/chip", file=sys.stderr)
-            if tps > best[2]:
-                best = (bs, label, tps)
+            if tps / n_dev > best[2] / best[3]:
+                best = (bs, label, tps, n_dev)
 
-    best_bs, best_sm, best_tps = best
+    best_bs, best_sm, best_tps, best_ndev = best
     if best_bs is None:
         # Every sweep point failed: a 0.0 headline would read as a measured
         # claim. Fail loudly instead.
         print("bench: every sweep variant failed; no throughput to report",
               file=sys.stderr)
         sys.exit(1)
-    per_chip = best_tps / n_dev
+    per_chip = best_tps / best_ndev
     flops_tok = train_step_flops_per_token(base, SEQ)
     # MFU only means something against a real accelerator peak; on the CPU
     # fallback the v5e denominator would make the figure nonsense.
@@ -256,7 +258,7 @@ def main():
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--one":
-        _time_batch_one(sys.argv[2])
+    if len(sys.argv) == 4 and sys.argv[1] == "--one":
+        _time_batch_one(sys.argv[2], sys.argv[3])
     else:
         main()

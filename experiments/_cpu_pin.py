@@ -1,0 +1,22 @@
+"""Pin the CPU platform with virtual devices — shared __main__ boilerplate.
+
+Must be called BEFORE the first jax device use (this module itself imports
+jax only inside the function, after setting XLA_FLAGS, so importing it is
+side-effect free). Env vars alone do not work in this container: its
+sitecustomize imports jax at interpreter start, so the platform pin has to
+go through jax.config.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_virtual(n_devices: int = 8) -> None:
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += \
+            f" --xla_force_host_platform_device_count={n_devices}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

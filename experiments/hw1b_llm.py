@@ -102,13 +102,7 @@ if __name__ == "__main__":
                          "multi-stage configs")
     a = ap.parse_args()
     if a.cpu:
-        import os
+        from ._cpu_pin import pin_cpu_virtual
 
-        os.environ.setdefault("XLA_FLAGS", "")
-        if "host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-            os.environ["XLA_FLAGS"] += \
-                " --xla_force_host_platform_device_count=8"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        pin_cpu_virtual()
     main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append)

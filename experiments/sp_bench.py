@@ -65,14 +65,9 @@ def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
 
 
 if __name__ == "__main__":
-    import os
+    from ._cpu_pin import pin_cpu_virtual
 
-    os.environ.setdefault("XLA_FLAGS", "")
-    if "host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu_virtual()
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     main(quick=ap.parse_args().quick)
