@@ -61,18 +61,28 @@ class LlamaConfig:
     # Attention backend: "xla" (fused-softmax dot_generals), "pallas" (the
     # flash kernel), or "auto" (pallas iff running on TPU and the sequence is
     # at least ``flash_min_seq``). The crossover is measured, not guessed:
-    # at Dh=48 the flash kernel pads lanes to 128, so XLA wins until the
-    # O(T²) score tensor dominates around T≈4k (measured on v5e by
-    # experiments/attn_bench.py).
+    # with the dh-major wide-block kernel the flash path wins at every swept
+    # length on v5e — fwd+bwd 4.65 vs 4.77 ms at T=256 (and 25x at T=8192),
+    # +7% end-to-end on the train step (experiments/results/attn_bench.csv,
+    # BENCH_r04) — so "auto" takes it from the canonical T=256 up. Below 256
+    # it is unmeasured and auto stays on XLA.
     attention_impl: str = "auto"
-    flash_min_seq: int = 4096
+    flash_min_seq: int = 256
     # Stream flash-kernel operands in the dense [BH, Dh, T] layout instead of
     # [BH, T, Dh]. At head dims below 128 lanes (this model's 48) the
     # row-major layout pads every q/k/v/o and gradient transfer to 128 lanes
     # — 2.67x the useful HBM bytes at Dh=48 — while dh-major is exactly
-    # dense. Same math and MXU shapes (ops/flash_attention.py); off until
-    # the on-chip measurement (experiments/attn_bench.py) says it wins.
-    flash_dh_major: bool = False
+    # dense. Same math and MXU shapes (ops/flash_attention.py); on by
+    # default since the on-chip measurement (attn_bench.csv) says it wins
+    # at every swept length when combined with ``flash_block`` wide blocks.
+    flash_dh_major: bool = True
+    # Pallas block size cap (block_q = block_k = min(T, flash_block)). The
+    # kernel default 128 keeps VMEM small for long sequences; at T ≤ 512 a
+    # whole-sequence block ("wide": one grid step per (b, h), no
+    # online-softmax recurrence) is measured fastest on v5e at every swept
+    # length (experiments/results/attn_bench.csv) — 512 is therefore the
+    # default cap.
+    flash_block: int = 512
     # Dtype of the materialized [B·H, T, T] attention score tensor. The
     # default fp32 is what the PP/SP equivalence tests are calibrated to;
     # "bfloat16" halves the attention leg's dominant HBM tensor (softmax

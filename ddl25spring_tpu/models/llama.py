@@ -207,8 +207,10 @@ def attention(block: dict, x: jnp.ndarray, cfg: LlamaConfig,
         out = attn_fn(q, k, v)
     elif use_pallas:
         from ..ops.flash_attention import flash_attention
+        blk = min(t, cfg.flash_block)
         out = flash_attention(q, k, v, causal=True,
-                              dh_major=cfg.flash_dh_major)
+                              dh_major=cfg.flash_dh_major,
+                              block_q=blk, block_k=blk)
     else:
         out = _xla_attention(q, k, v, causal=True,
                              softmax_dtype=cfg.softmax_dtype)

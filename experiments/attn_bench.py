@@ -8,12 +8,13 @@ tokens-per-call constant. Results → ``experiments/results/attn_bench.csv``
 ``flash_min_seq`` crossover if that column says tpu — run this on the chip
 and commit the output when the tunnel is up).
 
-Expected shape of the numbers: at Dh=48 the
-flash kernel pads the lane dimension to 128, wasting ~62% of each MXU pass,
-while XLA's fused softmax handles the canonical T=256 shape well — so flash
-only catches up around T≈4096, where the O(T²) score materialization starts
-to dominate. ``LlamaConfig(attention_impl="auto")`` encodes exactly that
-crossover (pallas iff T ≥ flash_min_seq on TPU).
+Measured shape of the numbers (v5e, committed CSV): the row-major flash
+kernel loses below T≈4096 — it pads Dh=48 to 128 lanes on every HBM
+transfer — but the dh-major variant with whole-sequence blocks
+(``flash_dhm_wide``: dense [BH, Dh, T] layout, block_q=block_k=min(T,512))
+wins at every swept length, from 2.5% at the canonical T=256 to 25x at
+T=8192. ``LlamaConfig(attention_impl="auto")`` encodes exactly that
+result (dh-major wide pallas iff T ≥ flash_min_seq=256 on TPU).
 """
 
 from __future__ import annotations
@@ -36,7 +37,8 @@ def _sync(r):
 
 
 def _time(f, *args, n=20) -> float:
-    r = f(*args)
+    for _ in range(3):  # compile + settle: the tunneled platform's first
+        r = f(*args)    # dispatches carry latency that pollutes 20-rep means
     _sync(r)
     t0 = time.perf_counter()
     for _ in range(n):
