@@ -139,10 +139,19 @@ def _time_batch_subprocess(overrides: dict, bs: int, timeout: int
 
 
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
-                new_tokens: int = 128) -> float:
-    """Generated tokens/sec for the KV-cache decode loop (models/generate)."""
+                new_tokens: int = 128, bf16_params: bool = False) -> float:
+    """Generated tokens/sec for the KV-cache decode loop (models/generate).
+
+    ``bf16_params`` stores the weights in bf16 before decoding: the batch-1
+    decode step is matVEC weight-bandwidth-bound, so halving the stored
+    weight bytes is the single biggest serving lever (training keeps fp32
+    master params; casting a copy for inference is the deployment shape)."""
     from ddl25spring_tpu.models import generate as gen
     params = llama.init_llama(jax.random.key(0), cfg)
+    if bf16_params:
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, params)
     prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len),
                                 0, cfg.vocab_size)
     out = gen.generate(params, prompt, cfg, new_tokens)
@@ -254,11 +263,15 @@ def main():
     # batch 32 the serving case. Greedy, 64-token prompt, 128 new tokens.
     sys.stdout.flush()
     for dec_bs in ((1,) if PLATFORM in (None, "cpu") else (1, 32)):
-        try:
-            tps = time_decode(base, dec_bs)
-            print(f"decode batch {dec_bs:3d}: {tps:12.0f} tok/s", file=sys.stderr)
-        except Exception as e:  # never let the sidebar look like a failure
-            print(f"decode batch {dec_bs}: failed ({e})", file=sys.stderr)
+        for bf16p in ((False,) if PLATFORM in (None, "cpu") else (False, True)):
+            label = " bf16-params" if bf16p else ""
+            try:
+                tps = time_decode(base, dec_bs, bf16_params=bf16p)
+                print(f"decode batch {dec_bs:3d}{label}: {tps:12.0f} tok/s",
+                      file=sys.stderr)
+            except Exception as e:  # never let the sidebar look like a failure
+                print(f"decode batch {dec_bs}{label}: failed ({e})",
+                      file=sys.stderr)
 
 
 if __name__ == "__main__":

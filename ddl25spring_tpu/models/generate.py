@@ -141,31 +141,50 @@ def forward_cached(params: dict, tokens: jnp.ndarray, cache: dict,
 
 
 def _sample(key, logits: jnp.ndarray, temperature: float,
-            top_k: Optional[int]) -> jnp.ndarray:
-    """logits [B, V] → token ids [B]. temperature 0 = greedy (argmax)."""
+            top_k: Optional[int], top_p: Optional[float]) -> jnp.ndarray:
+    """logits [B, V] → token ids [B]. temperature 0 = greedy (argmax).
+    top_k and top_p (nucleus) filters compose: k-truncation first, then the
+    smallest prefix of the remaining distribution whose mass reaches p."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]    # [B, 1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # Static-shape nucleus filter: one descending sort + cumsum, then a
+        # per-row logit threshold — no gather/scatter back through sort
+        # indices. A token is kept iff the mass of strictly-better tokens is
+        # < p (so the top token always survives, and the boundary token that
+        # crosses p is included, matching the usual nucleus definition).
+        sorted_logits = -jnp.sort(-logits, axis=-1)            # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs       # exclusive
+        kept = mass_before < top_p                             # [B, V]
+        thresh = jnp.min(jnp.where(kept, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)               # [B, 1]
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
-                                   "top_k", "max_len"))
+                                   "top_k", "top_p", "max_len"))
 def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
              max_new_tokens: int, *, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              max_len: Optional[int] = None) -> jnp.ndarray:
     """prompt [B, Tp] → generated ids [B, max_new_tokens].
 
     One compiled program: prefill over the prompt, then a lax.scan of
     single-token decode steps with in-place cache writes. Greedy by default;
-    ``temperature``/``top_k`` enable sampling (``key`` required then).
+    ``temperature``/``top_k``/``top_p`` enable sampling (``key`` required
+    then).
     """
     b, tp = prompt.shape
     assert max_new_tokens >= 1, max_new_tokens
+    assert top_p is None or 0.0 < top_p <= 1.0, \
+        f"top_p must be in (0, 1], got {top_p}"  # p<=0 would mask every token
     if max_len is None:
         max_len = tp + max_new_tokens
     assert max_len >= tp + max_new_tokens, (max_len, tp, max_new_tokens)
@@ -176,14 +195,14 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     fused = _fuse_blocks(params["blocks"])   # once, hoisted out of the scan
     logits, cache = _forward_fused(params, fused, prompt, cache, 0, cfg)
     key, sub = jax.random.split(key)
-    first = _sample(sub, logits, temperature, top_k)
+    first = _sample(sub, logits, temperature, top_k, top_p)
 
     def step(carry, _):
         cache, tok, pos, key = carry
         logits, cache = _forward_fused(params, fused, tok[:, None], cache,
                                        pos, cfg)
         key, sub = jax.random.split(key)
-        nxt = _sample(sub, logits, temperature, top_k)
+        nxt = _sample(sub, logits, temperature, top_k, top_p)
         return (cache, nxt, pos + 1, key), nxt
 
     carry = (cache, first, jnp.asarray(tp, jnp.int32), key)

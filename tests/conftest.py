@@ -10,9 +10,14 @@ The env vars MUST be set before jax is imported anywhere.
 
 import os
 
+from experiments._cpu_pin import COLLECTIVE_TIMEOUT_FLAGS
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "collective" not in os.environ["XLA_FLAGS"]:
+    # Oversubscribed-core hardening — rationale in experiments/_cpu_pin.py.
+    os.environ["XLA_FLAGS"] += COLLECTIVE_TIMEOUT_FLAGS
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -21,6 +26,9 @@ import pytest  # noqa: E402
 # interpreter start, so env vars alone are too late — override via config,
 # which takes effect because no backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
+# Serialize dispatch: overlapped steps' collectives can deadlock the virtual
+# CPU mesh (failure mode 2 in experiments/_cpu_pin.py).
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 
 @pytest.fixture(scope="session")

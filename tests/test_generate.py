@@ -87,6 +87,44 @@ def test_sampled_generate_respects_top_k(params):
                 params, out[:, i:i + 1], cache, 2 + i, CFG)
 
 
+def test_nucleus_filter_keeps_smallest_covering_prefix():
+    """_sample with top_p on a hand-built distribution: probs
+    (0.5, 0.3, 0.15, 0.05) → p=0.6 keeps {0, 1} (token 1 crosses the
+    boundary and is included), p=0.4 keeps only {0}, p=1.0 keeps all."""
+    probs = jnp.array([[0.5, 0.3, 0.15, 0.05]])
+    logits = jnp.log(probs)
+    keys = jax.random.split(jax.random.PRNGKey(3), 200)
+
+    def support(top_p):
+        ids = [int(generate._sample(k, logits, 1.0, None, top_p)[0])
+               for k in keys]
+        return set(ids)
+
+    assert support(0.4) == {0}
+    assert support(0.6) <= {0, 1} and 1 in support(0.6)
+    assert support(1.0) <= {0, 1, 2, 3} and len(support(1.0)) >= 3
+
+
+def test_sampled_generate_respects_top_p(params):
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    out = generate.generate(params, prompt, CFG, 4, key=jax.random.PRNGKey(9),
+                            temperature=0.8, top_p=0.9)
+    assert out.shape == (1, 4)
+    # Replay: every sampled id must lie in the nucleus (smallest prefix of
+    # the temperature-scaled distribution reaching 0.9) of its step.
+    cache = generate.init_cache(CFG, 1, 6)
+    logits, cache = generate.forward_cached(params, prompt, cache, 0, CFG)
+    for i in range(4):
+        p = jax.nn.softmax(logits[0] / 0.8)
+        order = jnp.argsort(-p)
+        mass_before = jnp.cumsum(p[order]) - p[order]
+        nucleus = set(order[mass_before < 0.9].tolist())
+        assert int(out[0, i]) in nucleus, i
+        if i < 3:
+            logits, cache = generate.forward_cached(
+                params, out[:, i:i + 1], cache, 2 + i, CFG)
+
+
 def test_padding_idx_zero_embedding_in_decode():
     cfg = LlamaConfig(vocab_size=97, dmodel=32, num_heads=4, n_layers=2,
                       ctx_size=16, padding_idx=0)
