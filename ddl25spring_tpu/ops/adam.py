@@ -33,6 +33,18 @@ class FusedAdamState(NamedTuple):
     nu: optax.Params
 
 
+def adam_leaf_math(g, m, v, c1, c2, *, lr: float, b1: float, b2: float,
+                   eps: float):
+    """The per-leaf Adam recurrence, shared by every implementation here
+    and by ops.pallas_adam's jnp fallback (the Pallas kernel mirrors this
+    expression on Refs — keep the two in sync). Returns (update, m, v);
+    the update is the signed step BEFORE it is added to the params."""
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * jnp.square(g)
+    u = (-lr) * (m / c1) / (jnp.sqrt(v / c2) + eps)
+    return u, m, v
+
+
 def fused_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
                eps: float = 1e-8) -> optax.GradientTransformation:
     def init_fn(params):
@@ -48,9 +60,8 @@ def fused_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         c2 = 1.0 - b2 ** count.astype(jnp.float32)
 
         def leaf(g, m, v):
-            m = b1 * m + (1.0 - b1) * g
-            v = b2 * v + (1.0 - b2) * jnp.square(g)
-            u = (-learning_rate) * (m / c1) / (jnp.sqrt(v / c2) + eps)
+            u, m, v = adam_leaf_math(g, m, v, c1, c2, lr=learning_rate,
+                                     b1=b1, b2=b2, eps=eps)
             return u.astype(g.dtype), m, v
 
         # Flatten-then-unflatten rather than a tree.map returning tuples:

@@ -76,8 +76,15 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         grads = lax.pmean(grads, "data")          # the one collective per iter
         loss = lax.pmean(loss, "data")
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        if hasattr(optimizer, "apply_gradients"):
+            # Fused param+moment apply (ops.pallas_adam.FusedApplyAdam):
+            # one kernel pass over {p, m, v, g} instead of update + apply.
+            params, opt_state = optimizer.apply_gradients(
+                state.params, grads, state.opt_state)
+        else:
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
     sharded = jax.shard_map(

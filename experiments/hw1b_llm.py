@@ -104,5 +104,10 @@ if __name__ == "__main__":
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual
 
-        pin_cpu_virtual()
+        # Topologies with >4 collective participants starve the thunk
+        # runtime's worker pool on this host (mode 3 in _cpu_pin) — route
+        # them through the legacy per-replica-thread runtime.
+        n_participants = max((CONFIGS[c]["data"] * CONFIGS[c]["stage"]
+                              for c in a.configs), default=1)
+        pin_cpu_virtual(legacy_collectives=n_participants > 4)
     main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append)

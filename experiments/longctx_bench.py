@@ -1,0 +1,119 @@
+"""Long-context train-step throughput on the real accelerator.
+
+The reference caps sequence length at 256 (lab/tutorial_1b/primer/
+intro.py:10); long context is a capability this framework adds. Two legs of
+evidence already exist: standalone attention timing across sequence lengths
+(experiments/attn_bench.py — the flash kernel's 25x at T=8192) and ring-
+attention per-device memory scaling on the virtual mesh (experiments/
+sp_bench.py). This harness closes the loop end-to-end: the full train step
+(fused head+CE + Adam) at long sequence lengths on one chip, tokens held
+roughly constant per step, so the tokens/s column shows how throughput decays
+as T grows — i.e. what the O(T^2) attention leg costs in a real step when
+the rest of the step is O(T).
+
+Each (seq, attention) point runs in a subprocess with a hard timeout (same
+wedge-proofing as bench.py: libtpu is single-client and this platform fails
+by hanging). Results -> ``experiments/results/longctx_bench.csv`` with a
+``platform`` column; rows are only claim-bearing when it says tpu.
+
+Run (on the chip):
+    python -m experiments.longctx_bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+# (seq_len, per-step batch): ~16k tokens/step at every row, the measured
+# bench.py optimum at T=256.
+GRID = [(256, 64), (1024, 16), (2048, 8), (4096, 4), (8192, 2)]
+VARIANTS = {
+    # "flash" pins the pallas dh-major kernel (the path config.py's "auto"
+    # routes to at T>=256 on TPU); "xla" pins the dot_general+softmax path.
+    # The two columns show where the quadratic [T, T] score tensor starts to
+    # dominate the step and how much the flash kernel buys back.
+    "flash": {"attention_impl": "pallas", "flash_dh_major": True,
+              "flash_block": 512},
+    "xla": {"attention_impl": "xla"},
+}
+
+
+def _child(variant: str, seq: int, batch: int) -> None:
+    """Time one (variant, seq) train-step point; print 'tok/s step_ms'."""
+    import jax
+
+    if jax.default_backend() not in ("tpu",):
+        print("no accelerator in child", file=sys.stderr)
+        sys.exit(3)
+    import dataclasses
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops.adam import fused_adam
+    from ddl25spring_tpu.parallel import dp, make_mesh
+
+    cfg = dataclasses.replace(
+        LlamaConfig(dtype="bfloat16", ctx_size=seq), **VARIANTS[variant])
+    mesh = make_mesh({"data": 1})
+    params = llama.init_llama(jax.random.key(0), cfg)
+    opt = fused_adam(8e-4)
+    state = dp.replicate(mesh, dp.init_state(params, opt))
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq),
+                                0, cfg.vocab_size)
+    sharded = dp.shard_batch(mesh, tokens)
+    for _ in range(3):
+        state, loss = step(state, sharded)
+    float(loss)  # hard sync (block_until_ready unreliable on this tunnel)
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, sharded)
+    float(loss)
+    dt = time.perf_counter() - t0
+    print(batch * seq * steps / dt, dt / steps * 1e3)
+
+
+def main(quick: bool = False) -> None:
+    from . import common
+
+    sink = common.sink("longctx_bench.csv")
+    grid = GRID[:2] if quick else GRID
+    for seq, batch in grid:
+        for variant in VARIANTS:
+            cmd = [sys.executable, "-m", "experiments.longctx_bench",
+                   "--one", variant, str(seq), str(batch)]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=900)
+                if proc.returncode != 0:
+                    raise RuntimeError(proc.stderr.strip().splitlines()[-1]
+                                       if proc.stderr.strip() else "failed")
+                tps, step_ms = map(float, proc.stdout.split())
+            except Exception as e:
+                print(f"T={seq:5d} {variant:5s}: failed "
+                      f"({type(e).__name__}: {e})", flush=True)
+                continue
+            sink.write({"seq": seq, "batch": batch, "variant": variant,
+                        "platform": "tpu", "tokens_per_sec": round(tps, 1),
+                        "step_ms": round(step_ms, 3)})
+            print(f"T={seq:5d} {variant:5s}: {tps:10.0f} tok/s "
+                  f"({step_ms:.1f} ms/step)", flush=True)
+    print(f"-> {sink.path}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 5 and sys.argv[1] == "--one":
+        _child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+    else:
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--quick", action="store_true")
+        main(quick=ap.parse_args().quick)
