@@ -19,7 +19,6 @@ Adam). vs_baseline is the speedup over that number.
 
 import json
 import sys
-import time
 
 from ddl25spring_tpu.utils.probe import probe_default_platform
 
@@ -32,12 +31,8 @@ if PLATFORM is None:
     # Pin CPU before first device use (works even though sitecustomize
     # already imported jax — no backend is initialized yet).
     jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp  # noqa: E402
-
 from ddl25spring_tpu.config import LlamaConfig  # noqa: E402
-from ddl25spring_tpu.models import llama  # noqa: E402
-from ddl25spring_tpu.ops.adam import fused_adam  # noqa: E402
-from ddl25spring_tpu.parallel import dp, make_mesh  # noqa: E402
+from ddl25spring_tpu.parallel import make_mesh  # noqa: E402
 
 TORCH_CPU_BASELINE_TOKENS_PER_SEC = 520.0
 
@@ -71,35 +66,19 @@ def peak_flops_per_chip() -> float:
     return 197e12  # default to v5e — this project's bench hardware
 
 
-def time_batch(mesh, cfg, batch_size: int) -> float:
-    """Tokens/sec for the DP train step at the given per-chip batch size."""
-    n_dev = mesh.devices.size
-    params = llama.init_llama(jax.random.key(0), cfg)
-    # Single-pass fused Adam (ops/adam.py): same update as optax.adam(8e-4)
-    # (asserted ≤1e-6 in tests/test_core.py) with fewer HBM round trips over
-    # the 24 M-param state — the optimizer leg is memory-bound.
-    opt = fused_adam(8e-4)
-    state = dp.replicate(mesh, dp.init_state(params, opt))
+def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused") -> float:
+    """Tokens/sec for the DP train step at the given per-chip batch size.
 
-    def loss_fn(p, batch):
-        return llama.forward_loss(p, batch, cfg)
-
-    step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
-    tokens = jax.random.randint(jax.random.key(1), (n_dev * batch_size, SEQ),
-                                0, cfg.vocab_size)
-    batch = dp.shard_batch(mesh, tokens)
-
-    for _ in range(WARMUP):
-        state, loss = step(state, batch)
-    float(loss)  # host transfer: hard sync (block_until_ready is unreliable
-    #              on the experimental tunneled-TPU platform this runs under)
-    t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
-        state, loss = step(state, batch)
-    float(loss)  # forces the whole timed chain
-    dt = time.perf_counter() - t0
-    del state
-    return n_dev * batch_size * SEQ * TIMED_STEPS / dt
+    ``opt_name``: "fused" = single-pass fused Adam (ops/adam.py — same update
+    as optax.adam(8e-4), asserted ≤1e-6 in tests/test_core.py, fewer HBM
+    round trips over the 24 M-param state); "pallas" = the fully-fused
+    Pallas apply (ops/pallas_adam.py — moments + param write in one kernel
+    pass per leaf). The optimizer leg is memory-bound either way; the sweep
+    measures which fusion wins on the chip.
+    """
+    from ddl25spring_tpu.bench_utils import time_train_step
+    return time_train_step(mesh, cfg, batch_size, seq=SEQ, opt_name=opt_name,
+                           warmup=WARMUP, timed_steps=TIMED_STEPS)
 
 
 def _time_batch_one(overrides_json: str, batch: str) -> None:
@@ -117,11 +96,12 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
     if PLATFORM in (None, "cpu"):
         print("child probe found no accelerator", file=sys.stderr)
         sys.exit(3)
-    cfg = dataclasses.replace(LlamaConfig(dtype="bfloat16"),
-                              **_json.loads(overrides_json))
+    overrides = _json.loads(overrides_json)
+    opt_name = overrides.pop("_opt", "fused")  # reserved key, not a cfg field
+    cfg = dataclasses.replace(LlamaConfig(dtype="bfloat16"), **overrides)
     n_dev = len(jax.devices())
     mesh = make_mesh({"data": n_dev})
-    print(time_batch(mesh, cfg, int(batch)), n_dev)
+    print(time_batch(mesh, cfg, int(batch), opt_name=opt_name), n_dev)
 
 
 def _time_batch_subprocess(overrides: dict, bs: int, timeout: int
@@ -140,28 +120,10 @@ def _time_batch_subprocess(overrides: dict, bs: int, timeout: int
 
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                 new_tokens: int = 128, bf16_params: bool = False) -> float:
-    """Generated tokens/sec for the KV-cache decode loop (models/generate).
-
-    ``bf16_params`` stores the weights in bf16 before decoding: the batch-1
-    decode step is matVEC weight-bandwidth-bound, so halving the stored
-    weight bytes is the single biggest serving lever (training keeps fp32
-    master params; casting a copy for inference is the deployment shape)."""
-    from ddl25spring_tpu.models import generate as gen
-    params = llama.init_llama(jax.random.key(0), cfg)
-    if bf16_params:
-        params = jax.tree.map(
-            lambda a: a.astype(jnp.bfloat16)
-            if a.dtype == jnp.float32 else a, params)
-    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len),
-                                0, cfg.vocab_size)
-    out = gen.generate(params, prompt, cfg, new_tokens)
-    jax.block_until_ready(out)                      # compile + warm
-    t0 = time.perf_counter()
-    reps = 3
-    for _ in range(reps):
-        out = gen.generate(params, prompt, cfg, new_tokens)
-    jax.block_until_ready(out)
-    return batch * new_tokens * reps / (time.perf_counter() - t0)
+    """Decode tokens/sec — the shared core (bench_utils.time_decode)."""
+    from ddl25spring_tpu.bench_utils import time_decode as _td
+    return _td(cfg, batch, prompt_len=prompt_len, new_tokens=new_tokens,
+               bf16_params=bf16_params)
 
 
 def main():
@@ -183,18 +145,25 @@ def main():
         # bench's one JSON line.
         flash_overrides = {"attention_impl": "pallas",
                            "flash_dh_major": True, "flash_block": 512}
-        for bs in (32, 64, 128):
-            try:
-                tps, child_ndev = _time_batch_subprocess(
-                    flash_overrides, bs, timeout=600)
-            except Exception as e:
-                print(f"batch {bs:4d} attn=flash-dhm : failed "
-                      f"({type(e).__name__}: {e})", file=sys.stderr)
-                continue
-            print(f"batch {bs:4d} attn=flash-dhm : {tps/child_ndev:12.0f} "
-                  f"tok/s/chip", file=sys.stderr)
-            if tps / child_ndev > best[2]:
-                best = (bs, "flash-dhm", tps / child_ndev)
+        # The pallas-Adam variant only at the known-optimal batch: the
+        # optimizer leg's cost is batch-independent, so one point decides
+        # whether the fused apply beats XLA's fusion on this chip.
+        pallas_sweep = [(flash_overrides, "flash-dhm", (32, 64, 128)),
+                        ({**flash_overrides, "_opt": "pallas"},
+                         "flash-dhm+padam", (64,))]
+        for overrides, label, batches in pallas_sweep:
+            for bs in batches:
+                try:
+                    tps, child_ndev = _time_batch_subprocess(
+                        overrides, bs, timeout=600)
+                except Exception as e:
+                    print(f"batch {bs:4d} attn={label:15s}: failed "
+                          f"({type(e).__name__}: {e})", file=sys.stderr)
+                    continue
+                print(f"batch {bs:4d} attn={label:15s}: "
+                      f"{tps/child_ndev:12.0f} tok/s/chip", file=sys.stderr)
+                if tps / child_ndev > best[2]:
+                    best = (bs, label, tps / child_ndev)
 
     n_dev = len(jax.devices())            # initializes this process's backend
     mesh = make_mesh({"data": n_dev})

@@ -1,0 +1,94 @@
+"""Shared benchmark timing cores, used by bench.py and experiments/*.
+
+One implementation of "time the DP train step / the decode loop on this
+platform" so the headline bench and the experiment harnesses cannot drift
+in timing methodology. All timings are async-dispatch honest: the timed
+chain ends in a host transfer (``float(loss)``) because
+``block_until_ready`` is unreliable on the tunneled-TPU platform this
+project benches on.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import LlamaConfig
+from .models import llama
+from .ops.adam import fused_adam
+from .parallel import dp
+
+
+def make_optimizer(opt_name: str, lr: float = 8e-4):
+    """"fused" = single-pass fused Adam (ops/adam.py — same update as
+    optax.adam, asserted ≤1e-6 in tests/test_core.py, fewer HBM round trips
+    over the parameter-sized state); "pallas" = the fully-fused Pallas apply
+    (ops/pallas_adam.py — moments + param write in one kernel pass per
+    leaf). The optimizer leg is memory-bound either way; benches measure
+    which fusion wins on the chip at hand."""
+    if opt_name == "pallas":
+        from .ops.pallas_adam import FusedApplyAdam
+        return FusedApplyAdam(lr)
+    return fused_adam(lr)
+
+
+def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
+                    seq: Optional[int] = None, opt_name: str = "fused",
+                    warmup: int = 3, timed_steps: int = 20) -> float:
+    """Total tokens/sec of the DP train step at the given per-chip batch.
+
+    ``seq`` defaults to ``cfg.ctx_size``. The caller divides by its device
+    count for a per-chip figure."""
+    seq = seq or cfg.ctx_size
+    n_dev = mesh.devices.size
+    params = llama.init_llama(jax.random.key(0), cfg)
+    opt = make_optimizer(opt_name)
+    state = dp.replicate(mesh, dp.init_state(params, opt))
+
+    def loss_fn(p, batch):
+        return llama.forward_loss(p, batch, cfg)
+
+    step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (n_dev * batch_size, seq),
+                                0, cfg.vocab_size)
+    batch = dp.shard_batch(mesh, tokens)
+
+    for _ in range(warmup):
+        state, loss = step(state, batch)
+    float(loss)  # hard sync before the timer
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, loss = step(state, batch)
+    float(loss)  # forces the whole timed chain
+    dt = time.perf_counter() - t0
+    del state
+    return n_dev * batch_size * seq * timed_steps / dt
+
+
+def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
+                new_tokens: int = 128, bf16_params: bool = False,
+                reps: int = 3) -> float:
+    """Generated tokens/sec for the KV-cache decode loop (models/generate).
+
+    ``bf16_params`` stores the weights in bf16 before decoding: the batch-1
+    decode step is matVEC weight-bandwidth-bound, so halving the stored
+    weight bytes is the single biggest serving lever (training keeps fp32
+    master params; casting a copy for inference is the deployment shape)."""
+    from .models import generate as gen
+    params = llama.init_llama(jax.random.key(0), cfg)
+    if bf16_params:
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, params)
+    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len),
+                                0, cfg.vocab_size)
+    out = gen.generate(params, prompt, cfg, new_tokens)
+    jax.block_until_ready(out)                      # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = gen.generate(params, prompt, cfg, new_tokens)
+    jax.block_until_ready(out)
+    return batch * new_tokens * reps / (time.perf_counter() - t0)

@@ -45,11 +45,18 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  log_fn: Callable[[str], None] = print,
                  warmup_steps_excluded: int = 2,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 1000) -> LLMTrainReport:
+                 checkpoint_every: int = 1000,
+                 loss_sink: Optional[Callable[[int, float], None]] = None,
+                 sink_every: int = 10) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
     ``aggregation``: "gradient" (allreduce grads — intro_DP_GA) or "weight"
     (allreduce weights post-step — intro_DP_WA's intended semantics).
+
+    ``loss_sink(it, loss)`` fires every ``sink_every`` iterations with the
+    host-synced loss — for incremental result recording that survives a
+    killed run (each call forces a device sync; use only where the step
+    time dwarfs it, e.g. the oversubscribed virtual-CPU mesh).
 
     ``checkpoint_dir`` enables orbax checkpoint/resume (the persistence layer
     the reference lacks, SURVEY.md §5.4): the latest step in the directory is
@@ -113,6 +120,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             float(loss)  # hard sync before starting the timer
             t_start = time.perf_counter()
         device_losses.append(loss)
+        if loss_sink is not None and (it % sink_every == 0
+                                      or it == train_cfg.iters - 1):
+            loss_sink(it, float(loss))
         if log_every and it % log_every == 0:
             log_fn(f"iter {it}: loss {float(loss):.4f}")
         if ckpt is not None and (it + 1) % checkpoint_every == 0:
@@ -138,7 +148,11 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                  schedule: str = "gpipe",
                  log_every: int = 100,
                  log_fn: Callable[[str], None] = print,
-                 warmup_steps_excluded: int = 2) -> LLMTrainReport:
+                 warmup_steps_excluded: int = 2,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1000,
+                 loss_sink: Optional[Callable[[int, float], None]] = None,
+                 sink_every: int = 10) -> LLMTrainReport:
     """Pipeline(-x-data)-parallel tiny-Llama training; returns losses and
     throughput.
 
@@ -150,10 +164,13 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     data shard reads a disjoint stream window (shard_skip=5000), matching
     the reference's per-pipeline data offset.
 
-    The loop mirrors train_llm_dp's timing/throughput accounting but
-    deliberately omits its checkpoint/resume plumbing (orbax restore +
-    stream replay) — add it here if pipeline runs ever need resume; keep
-    the two loops' timing semantics in sync when touching either.
+    ``checkpoint_dir`` enables orbax checkpoint/resume with stream replay,
+    the same contract as train_llm_dp: restore the latest step (sharding-
+    preserving — stage-sharded params land back on their stages), skip
+    already-completed iterations while still consuming the token stream so
+    data order is preserved, save every ``checkpoint_every`` steps and at
+    the end. The loop mirrors train_llm_dp's timing/throughput accounting;
+    keep the two loops' semantics in sync when touching either.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
@@ -172,27 +189,56 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                                     n_microbatches=train_cfg.microbatches,
                                     schedule=schedule)
 
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from ..checkpoint import Checkpointer
+        ckpt = Checkpointer(checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            start_step = int(ckpt.latest_step())
+            log_fn(f"resumed from step {start_step}")
+        if start_step >= train_cfg.iters:
+            log_fn(f"checkpoint already at step {start_step} >= "
+                   f"iters {train_cfg.iters}; nothing to train")
+            ckpt.close()
+            return LLMTrainReport()
+
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
                               n_data, shard_skip=5000, seed=train_cfg.seed)
 
     report = LLMTrainReport()
+    last_saved = -1
     tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
     t_start = None
     device_losses = []
     for it in range(train_cfg.iters):
         host_batch = next(batches).reshape(
             n_data * train_cfg.batch_size, train_cfg.seq_len)
+        if it < start_step:
+            continue  # resume: replay the stream so data order is preserved
         state, loss = step_fn(state, pp.shard_batch(mesh, host_batch))
-        if it + 1 == warmup_steps_excluded:
+        if it + 1 == start_step + warmup_steps_excluded:
             float(loss)  # hard sync before starting the timer
             t_start = time.perf_counter()
         device_losses.append(loss)
+        if loss_sink is not None and (it % sink_every == 0
+                                      or it == train_cfg.iters - 1):
+            loss_sink(it, float(loss))
         if log_every and it % log_every == 0:
             log_fn(f"iter {it}: loss {float(loss):.4f}")
+        if ckpt is not None and (it + 1) % checkpoint_every == 0:
+            ckpt.save(it + 1, state)
+            last_saved = it + 1
+    if ckpt is not None:
+        if train_cfg.iters != last_saved:
+            ckpt.save(train_cfg.iters, state, force=True)
+        ckpt.close()
     report.losses = [float(l) for l in device_losses]
-    report.steps = train_cfg.iters
-    if t_start is not None and train_cfg.iters > warmup_steps_excluded:
+    report.steps = train_cfg.iters - start_step
+    if t_start is not None and (train_cfg.iters - start_step
+                                > warmup_steps_excluded):
         report.wall_time = time.perf_counter() - t_start
-        timed = train_cfg.iters - warmup_steps_excluded
+        timed = train_cfg.iters - start_step - warmup_steps_excluded
         report.tokens_per_sec = tokens_per_step * timed / report.wall_time
     return report

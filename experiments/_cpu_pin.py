@@ -26,34 +26,31 @@ import os
 #    arrivals at both 40 s and 1200 s). No timeout fixes this one —
 #    dispatch must be serialized (`jax_cpu_enable_async_dispatch=False`,
 #    applied in pin_cpu_virtual / conftest / the dryrun child).
-# 3. POOL STARVATION (thunk runtime): even with 1+2 applied, the thunk
-#    executor runs collective thunks on a shared Eigen pool whose size on
-#    this 1-core host (~4 workers) is below a 6-participant topology. A
-#    blocking rendezvous parks a worker, so once every worker holds a
-#    waiting collective the remaining replicas can never arrive: the
-#    6-device DP×PP run wedged within ~100 iters at a cross-module
-#    ppermute with 4/6 arrivals (exactly the pool size), 0% CPU. The
-#    3-participant pp3 run fits the pool and never wedges. Fix: the
-#    legacy (non-thunk) runtime executes each replica on its own thread,
-#    so blocked collectives time-share instead of exhausting a pool —
-#    ``legacy_collectives=True`` below; measured 50-iter dp2_pp3 smoke
-#    runs clean at ~106 tok/s where the thunk runtime deadlocked.
+# 3. RESIDUAL STOCHASTIC WEDGE: even with 1+2 applied, the 6-participant
+#    DP×PP topology still wedges within ~100 iterations at a cross-module
+#    ppermute with 4-5/6 arrivals and 0% CPU — the thunk executor runs
+#    collective thunks on a shared worker pool that a blocking rendezvous
+#    can park, and on this host the pool is smaller than 6. (The
+#    3-participant pp3 topology fits and never wedges; a 50-iter
+#    6-participant smoke can pass by luck.) There is NO runtime-level fix
+#    in this XLA build — the legacy non-thunk runtime is gone
+#    (``--xla_cpu_use_thunk_runtime`` warns "no longer supported" and is a
+#    no-op). Long runs on big virtual topologies must instead be made
+#    kill-safe: orbax checkpoint/resume + incremental CSV sinking +
+#    ``experiments/watchdog.py`` (kill on progress stall, relaunch,
+#    resume).
 COLLECTIVE_TIMEOUT_FLAGS = (
     " --xla_cpu_collective_timeout_seconds=1200"
     " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
-LEGACY_RUNTIME_FLAG = " --xla_cpu_use_thunk_runtime=false"
 
 
-def pin_cpu_virtual(n_devices: int = 8,
-                    legacy_collectives: bool = False) -> None:
+def pin_cpu_virtual(n_devices: int = 8) -> None:
     os.environ.setdefault("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
         os.environ["XLA_FLAGS"] += \
             f" --xla_force_host_platform_device_count={n_devices}"
     if "collective" not in os.environ["XLA_FLAGS"]:
         os.environ["XLA_FLAGS"] += COLLECTIVE_TIMEOUT_FLAGS
-    if legacy_collectives and "thunk_runtime" not in os.environ["XLA_FLAGS"]:
-        os.environ["XLA_FLAGS"] += LEGACY_RUNTIME_FLAG  # mode 3 above
     import jax
 
     jax.config.update("jax_platforms", "cpu")

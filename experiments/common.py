@@ -26,6 +26,30 @@ def sink(name: str) -> ResultSink:
     return ResultSink(path)
 
 
+def dedupe_csv(path: str, key_cols: List[str]) -> int:
+    """Drop exact-duplicate rows by ``key_cols`` (keep first), preserving
+    order. Watchdogged resume runs re-emit identical rows for the overlap
+    between the last checkpoint and the kill point; this cleans them.
+    Returns the number of rows removed."""
+    import csv
+
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    seen, kept = set(), []
+    for r in rows:
+        k = tuple(r.get(c) for c in key_cols)
+        if k in seen:
+            continue
+        seen.add(k)
+        kept.append(r)
+    if len(kept) < len(rows):
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=rows[0].keys())
+            w.writeheader()
+            w.writerows(kept)
+    return len(rows) - len(kept)
+
+
 def mnist_provenance() -> str:
     """Whether load_mnist() will return real IDX files or the synthetic
     fallback (mirrors its search order)."""

@@ -33,26 +33,45 @@ CONFIGS = {
 }
 
 
-def _run_config(name: str, iters: int, sink, provenance: str
-                ) -> Dict[str, float]:
+def _run_config(name: str, iters: int, sink, provenance: str,
+                checkpoint_dir: str = None) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
     train_cfg = TrainConfig(iters=iters, **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
     label = f"{name}_b{train_cfg.data * train_cfg.batch_size}_seq256_adam8e-4"
-    log_every = max(1, iters // 10)
+    log_every = max(1, min(iters // 10, 25))
+    kw = {}
+    if checkpoint_dir is not None:
+        # Watchdogged runs: resume from the latest checkpoint, save often,
+        # and stream rows into the CSV as they happen — a killed run loses
+        # at most sink_every iterations of record (a retried segment
+        # re-writes identical rows; dedupe_csv cleans the overlap).
+        # Per-config subdir: configs have differently-shaped/sharded states,
+        # so sharing one orbax dir across them would restore garbage.
+        import os
+        kw = dict(checkpoint_dir=os.path.join(checkpoint_dir, name),
+                  checkpoint_every=50,
+                  loss_sink=lambda it, loss: sink.write(
+                      {"iter": it, "loss": loss, "data": provenance,
+                       "config": label}))
     if topo["stage"] > 1:
-        report = train_llm_pp(model_cfg, train_cfg, log_every=log_every)
+        report = train_llm_pp(model_cfg, train_cfg, log_every=log_every, **kw)
     else:
-        report = train_llm_dp(model_cfg, train_cfg, log_every=log_every)
-    for it in range(0, len(report.losses), 10):
-        sink.write({"iter": it, "loss": report.losses[it], "data": provenance,
-                    "config": label})
-    sink.write({"iter": len(report.losses) - 1, "loss": report.losses[-1],
-                "data": provenance, "config": label})
+        report = train_llm_dp(model_cfg, train_cfg, log_every=log_every, **kw)
+    if not report.losses:
+        return {}  # resumed past the end; nothing new to record
+    base = iters - len(report.losses)  # resume offset (0 for a fresh run)
+    if checkpoint_dir is None:  # sink mode already wrote its rows
+        for it in range(0, len(report.losses), 10):
+            sink.write({"iter": base + it, "loss": report.losses[it],
+                        "data": provenance, "config": label})
+        sink.write({"iter": base + len(report.losses) - 1,
+                    "loss": report.losses[-1],
+                    "data": provenance, "config": label})
     print(f"{name}: loss {report.losses[0]:.3f} -> {report.losses[-1]:.3f} "
-          f"over {iters} iters ({report.tokens_per_sec:.0f} tok/s) "
+          f"over iters {base}..{iters} ({report.tokens_per_sec:.0f} tok/s) "
           f"[{provenance}]", flush=True)
     return {f"{name}_first": report.losses[0],
             f"{name}_last": report.losses[-1],
@@ -60,7 +79,8 @@ def _run_config(name: str, iters: int, sink, provenance: str
 
 
 def main(quick: bool = False, iters: int = 5000,
-         configs=("dp1",), append: bool = False) -> Dict[str, float]:
+         configs=("dp1",), append: bool = False,
+         checkpoint_dir: str = None) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -70,6 +90,11 @@ def main(quick: bool = False, iters: int = 5000,
     from ddl25spring_tpu.utils.tracing import ResultSink
 
     provenance = common.tinystories_provenance()
+    if checkpoint_dir is not None and not append:
+        # A resumed run only re-emits rows from its checkpoint onward; a
+        # fresh (replacing) sink would silently truncate the curve's head.
+        raise ValueError("--checkpoint-dir requires --append: a resumed run "
+                         "cannot rebuild the CSV rows before its checkpoint")
     if quick:
         iters = 50
     if append:
@@ -79,10 +104,11 @@ def main(quick: bool = False, iters: int = 5000,
         sink = common.sink("hw1b_llm_loss.csv")
     out: Dict[str, float] = {}
     for name in configs:
-        out.update(_run_config(name, iters, sink, provenance))
+        out.update(_run_config(name, iters, sink, provenance,
+                               checkpoint_dir=checkpoint_dir))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
-    if len(configs) == 1:
+    if len(configs) == 1 and f"{configs[0]}_first" in out:
         n = configs[0]
         out = {"first": out[f"{n}_first"], "last": out[f"{n}_last"],
                "tokens_per_sec": out[f"{n}_tokens_per_sec"]}
@@ -100,14 +126,18 @@ if __name__ == "__main__":
     ap.add_argument("--cpu", action="store_true",
                     help="pin CPU and force enough virtual devices for the "
                          "multi-stage configs")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="orbax checkpoint/resume dir — lets a watchdog "
+                         "kill and relaunch a wedged virtual-mesh run "
+                         "without losing progress (saves every 50 iters)")
     a = ap.parse_args()
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual
 
-        # Topologies with >4 collective participants starve the thunk
-        # runtime's worker pool on this host (mode 3 in _cpu_pin) — route
-        # them through the legacy per-replica-thread runtime.
-        n_participants = max((CONFIGS[c]["data"] * CONFIGS[c]["stage"]
-                              for c in a.configs), default=1)
-        pin_cpu_virtual(legacy_collectives=n_participants > 4)
-    main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append)
+        # NOTE: topologies with ~6 collective participants can wedge
+        # stochastically on this host (mode 3 in _cpu_pin — no runtime
+        # fix exists); drive them through experiments/watchdog.py with
+        # --checkpoint-dir so a killed run resumes.
+        pin_cpu_virtual()
+    main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append,
+         checkpoint_dir=a.checkpoint_dir)

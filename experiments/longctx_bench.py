@@ -23,10 +23,8 @@ Run (on the chip):
 from __future__ import annotations
 
 import argparse
-import json
 import subprocess
 import sys
-import time
 
 # (seq_len, per-step batch): ~16k tokens/step at every row, the measured
 # bench.py optimum at T=256.
@@ -51,35 +49,16 @@ def _child(variant: str, seq: int, batch: int) -> None:
         sys.exit(3)
     import dataclasses
 
+    from ddl25spring_tpu.bench_utils import time_train_step
     from ddl25spring_tpu.config import LlamaConfig
-    from ddl25spring_tpu.models import llama
-    from ddl25spring_tpu.ops.adam import fused_adam
-    from ddl25spring_tpu.parallel import dp, make_mesh
+    from ddl25spring_tpu.parallel import make_mesh
 
     cfg = dataclasses.replace(
         LlamaConfig(dtype="bfloat16", ctx_size=seq), **VARIANTS[variant])
     mesh = make_mesh({"data": 1})
-    params = llama.init_llama(jax.random.key(0), cfg)
-    opt = fused_adam(8e-4)
-    state = dp.replicate(mesh, dp.init_state(params, opt))
-
-    def loss_fn(p, b):
-        return llama.forward_loss(p, b, cfg)
-
-    step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
-    tokens = jax.random.randint(jax.random.key(1), (batch, seq),
-                                0, cfg.vocab_size)
-    sharded = dp.shard_batch(mesh, tokens)
-    for _ in range(3):
-        state, loss = step(state, sharded)
-    float(loss)  # hard sync (block_until_ready unreliable on this tunnel)
     steps = 10
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step(state, sharded)
-    float(loss)
-    dt = time.perf_counter() - t0
-    print(batch * seq * steps / dt, dt / steps * 1e3)
+    tps = time_train_step(mesh, cfg, batch, seq=seq, timed_steps=steps)
+    print(tps, batch * seq / tps * 1e3)
 
 
 def main(quick: bool = False) -> None:

@@ -1,0 +1,95 @@
+"""Stall-watchdog runner for wedge-prone virtual-mesh training runs.
+
+The oversubscribed 1-core host can wedge an XLA-CPU collective rendezvous
+mid-run (failure modes 1-3 in experiments/_cpu_pin.py; mode 3's legacy-
+runtime fix still leaves a residual stochastic wedge on 6-participant
+topologies). This driver makes long runs immune by construction: launch the
+training command, watch its progress file (the CSV the run streams rows
+into), and if the file stops growing for ``--stall-min`` minutes, kill the
+process and relaunch — the run resumes from its orbax checkpoint and
+re-streams only the lost tail. On success, duplicate rows from retried
+segments are deduped in place.
+
+Example (the b2-topology loss curve):
+    python -m experiments.watchdog \
+        --progress experiments/results/hw1b_llm_loss.csv \
+        --dedupe-keys config iter -- \
+        python -m experiments.hw1b_llm --cpu --configs dp2_pp3 \
+        --iters 1000 --append --checkpoint-dir /tmp/ck_dp2pp3
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+
+def file_size(path: str) -> int:
+    try:
+        return os.stat(path).st_size
+    except OSError:
+        return -1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--progress", required=True,
+                    help="file whose growth proves the run is alive")
+    ap.add_argument("--stall-min", type=float, default=12.0,
+                    help="kill+relaunch after this many minutes without "
+                         "progress-file growth")
+    ap.add_argument("--max-restarts", type=int, default=30)
+    ap.add_argument("--dedupe-keys", nargs="*", default=None,
+                    help="CSV columns identifying a row; dedupe the "
+                         "progress file on success")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- then the training command")
+    a = ap.parse_args()
+    cmd = a.cmd[1:] if a.cmd and a.cmd[0] == "--" else a.cmd
+    if not cmd:
+        ap.error("no command given after --")
+
+    poll_s = 30.0
+    for attempt in range(a.max_restarts + 1):
+        print(f"[watchdog] attempt {attempt}: {' '.join(cmd)}", flush=True)
+        proc = subprocess.Popen(cmd)
+        last_size = file_size(a.progress)
+        last_change = time.time()
+        while True:
+            try:
+                rc = proc.wait(timeout=poll_s)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            size = file_size(a.progress)
+            if size != last_size:
+                last_size, last_change = size, time.time()
+            elif time.time() - last_change > a.stall_min * 60:
+                print(f"[watchdog] no growth of {a.progress} for "
+                      f"{a.stall_min} min — killing pid {proc.pid}",
+                      flush=True)
+                proc.kill()
+                proc.wait()
+                rc = None
+                break
+        if rc == 0:
+            if a.dedupe_keys:
+                from .common import dedupe_csv
+                removed = dedupe_csv(a.progress, a.dedupe_keys)
+                print(f"[watchdog] done; deduped {removed} retried rows",
+                      flush=True)
+            else:
+                print("[watchdog] done", flush=True)
+            return 0
+        if rc is not None:
+            print(f"[watchdog] command exited rc={rc}; retrying from "
+                  f"checkpoint", flush=True)
+    print("[watchdog] gave up after max restarts", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

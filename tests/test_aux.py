@@ -241,3 +241,33 @@ def test_train_llm_dp_checkpoint_resume(tmp_path):
     assert len(first.losses) == 3 and len(resumed.losses) == 3
     np.testing.assert_allclose(first.losses + resumed.losses, full.losses,
                                rtol=2e-5)
+
+
+def test_train_llm_pp_checkpoint_resume(tmp_path):
+    """Same resume contract for the pipeline trainer: the stage-sharded
+    state restores onto its stages and the replayed stream matches an
+    uninterrupted run (train/llm.py train_llm_pp checkpoint_dir wiring).
+    Also exercises the incremental loss_sink used by watchdogged runs."""
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    model_cfg = LlamaConfig(vocab_size=128, dmodel=16, num_heads=2,
+                            n_layers=2, ctx_size=16)
+    kw = dict(log_every=0, warmup_steps_excluded=1)
+    base = dict(batch_size=2, seq_len=16, seed=3, stage=2, microbatches=2)
+
+    full = train_llm_pp(model_cfg, TrainConfig(iters=6, **base), **kw)
+
+    ck = str(tmp_path / "ck")
+    sunk = []
+    first = train_llm_pp(model_cfg, TrainConfig(iters=3, **base), **kw,
+                         checkpoint_dir=ck, checkpoint_every=100,
+                         loss_sink=lambda it, l: sunk.append((it, l)),
+                         sink_every=1)
+    resumed = train_llm_pp(model_cfg, TrainConfig(iters=6, **base), **kw,
+                           checkpoint_dir=ck, checkpoint_every=100)
+    assert len(first.losses) == 3 and len(resumed.losses) == 3
+    np.testing.assert_allclose(first.losses + resumed.losses, full.losses,
+                               rtol=2e-5)
+    assert [it for it, _ in sunk] == [0, 1, 2]  # absolute iteration indices
+    np.testing.assert_allclose([l for _, l in sunk], first.losses, rtol=1e-6)
