@@ -1,0 +1,154 @@
+"""Compressed gradient all-reduce for data parallelism.
+
+Capability/pattern target: the reference's DP loop all-reduces full-precision
+fp32 gradients every iteration (lab/tutorial_1b/DP/gradient_aggr/
+intro_DP_GA.py:53-66 — flatten, allreduce, scale); at multi-host scale the
+wire bytes of that allreduce are the step's bandwidth bill. Public pattern
+references for shrinking it inside an XLA program: EQuARX (quantized
+all-reduce in XLA, arxiv 2506.17615) and DynamiQ (compressed all-reduce,
+arxiv 2602.08923) — see PAPERS.md. This module implements the two standard
+operating points, TPU-first (the compression is elementwise work XLA fuses
+around one collective; no custom comm code):
+
+- **bf16 wire format** (``make_bf16_grad_step``): cast grads to bf16, pmean,
+  upcast. Halves the wire bytes; stateless; the mantissa loss per step is
+  ~1e-3 relative and unbiased enough in practice that it is the default
+  "free" lever on DCN-bound topologies.
+
+- **int8 + error feedback** (``make_int8_ef_grad_step``): per-leaf symmetric
+  quantization to int8 around the shard-group max (pmax-ed so every shard
+  uses the same fixed-point grid), then an **int8 all-gather** — the only
+  collective whose wire operand is the 1-byte tensor — followed by an exact
+  local int32 sum and dequantization. (A psum of the quantized values would
+  be mathematically identical but moves int32 on the wire — zero savings;
+  gathering the int8 shards keeps the wire at 1 byte/element, ~8× fewer
+  bytes than the fp32 allreduce's ≈2×4 bytes/element, at the cost of an
+  n_shards× int8 transient per leaf.) The local quantization residual is
+  fed back into the next step's gradient (error feedback — the standard fix
+  that restores convergence for biased compressors).
+
+Both factories return ``(state, step_fn)`` with the same TrainState the
+plain step uses; the int8 variant carries its residual tree inside an
+extended state tuple. Equivalence/convergence pinned in
+tests/test_compress.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .dp import TrainState, init_state, replicate
+
+
+def _pmean_bf16(grads, axis: str):
+    """pmean with a bf16 wire format: the collective moves half the bytes;
+    accumulation happens in the reduction's native precision."""
+    down = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
+    summed = lax.pmean(down, axis)
+    return jax.tree.map(lambda g, ref: g.astype(ref.dtype), summed, grads)
+
+
+def make_bf16_grad_step(loss_fn: Callable,
+                        optimizer: optax.GradientTransformation,
+                        mesh: Mesh) -> Callable:
+    """The plain DP gradient-aggregation step with a bf16 collective.
+
+    Drop-in for ``dp.make_grad_aggregation_step`` — same TrainState, same
+    loss semantics; only the gradient allreduce's wire format changes."""
+
+    def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        grads = _pmean_bf16(grads, "data")
+        loss = lax.pmean(loss, "data")
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P("data")), out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+class EFTrainState(NamedTuple):
+    """TrainState + the per-shard error-feedback residual tree."""
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+    residual: Any
+
+
+def init_ef_state(mesh: Mesh, params,
+                  optimizer: optax.GradientTransformation) -> EFTrainState:
+    """The residual is PER-SHARD state (each shard compensates its own
+    quantization error): materialized as a ``[n_data, ...]``-stacked tree
+    sharded over ``data``, so each shard owns one zero-initialized slice."""
+    base = replicate(mesh, init_state(params, optimizer))
+    n = mesh.shape["data"]
+    stacked = jax.tree.map(
+        lambda p: jnp.zeros((n,) + p.shape, p.dtype), params)
+    stacked = jax.device_put(stacked, NamedSharding(mesh, P("data")))
+    return EFTrainState(base.params, base.opt_state, base.step, stacked)
+
+
+def make_int8_ef_grad_step(loss_fn: Callable,
+                           optimizer: optax.GradientTransformation,
+                           mesh: Mesh) -> Callable:
+    """DP step with int8-quantized gradient allreduce + error feedback.
+
+    Per leaf and per step, on each shard: ``c = g_local + residual`` →
+    shared scale ``s = pmax(max|c|)/127`` → ``q = round(c/s)`` (int8 range)
+    → **int8 all-gather** (the wire leg) → exact local int32 sum →
+    ``g_avg = s·Σq/n`` → new residual ``c − s·q``. The optimizer consumes
+    ``g_avg``; the un-transmitted remainder re-enters next step, so the
+    compressor's bias does not accumulate.
+    """
+    n = mesh.shape["data"]
+
+    def local_step(state: EFTrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        loss = lax.pmean(loss, "data")
+
+        def leaf(g, r_stacked):
+            r = r_stacked[0]          # this shard's [1, ...] slice of the
+            c = g + r                 # stacked residual tree
+            # Shared symmetric scale: pmax keeps every shard's quantizer
+            # identical, so the int32 sum is a faithful fixed-point sum.
+            s = lax.pmax(jnp.max(jnp.abs(c)).astype(jnp.float32),
+                         "data") / 127.0
+            s = jnp.maximum(s, jnp.finfo(jnp.float32).tiny).astype(c.dtype)
+            q = jnp.clip(jnp.round(c / s), -127, 127).astype(jnp.int8)
+            # Wire leg: gather the int8 shards (1 byte/element on the
+            # collective), then sum locally in int32 — exact, and the only
+            # formulation where the moved bytes are actually compressed (a
+            # psum would up-cast the operand to int32 on the wire).
+            gathered = lax.all_gather(q, "data")          # [n, ...] int8
+            total = jnp.sum(gathered.astype(jnp.int32), axis=0)
+            g_avg = (s * total.astype(c.dtype) / n).astype(g.dtype)
+            return g_avg, (c - s * q.astype(c.dtype))[None]
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        pairs = [leaf(g, r) for g, r in
+                 zip(flat_g, jax.tree.leaves(state.residual))]
+        g_avg = jax.tree.unflatten(treedef, [p[0] for p in pairs])
+        residual = jax.tree.unflatten(treedef, [p[1] for p in pairs])
+        updates, opt_state = optimizer.update(g_avg, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return EFTrainState(params, opt_state, state.step + 1, residual), loss
+
+    state_specs = EFTrainState(P(), P(), P(), P("data"))
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_specs, P("data")),
+        out_specs=(state_specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))

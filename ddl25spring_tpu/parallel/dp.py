@@ -220,4 +220,15 @@ def shard_batch(mesh: Mesh, batch) -> jax.Array:
 
 
 def replicate(mesh: Mesh, tree):
-    return jax.device_put(tree, NamedSharding(mesh, P()))
+    """Replicate a host/device tree onto the mesh — via an explicit copy.
+
+    A plain device_put keeps the caller's own buffer as one replica shard,
+    and every step factory here donates its state: donating that aliased
+    buffer silently deletes the caller's original ('Array has been deleted'
+    when two states are built from one params tree — and ``may_alias=False``
+    does NOT prevent the alias on this backend, verified empirically). The
+    copy is init-time-only and insulates the caller's tree."""
+    fresh = jax.tree.map(
+        lambda x: jnp.array(x, copy=True) if isinstance(x, jax.Array) else x,
+        tree)
+    return jax.device_put(fresh, NamedSharding(mesh, P()))

@@ -36,6 +36,74 @@ class LLMTrainReport:
         return self.tokens_per_sec / max(n_devices, 1)
 
 
+def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
+                      log_fn: Callable[[str], None]):
+    """Shared resume preamble: open the orbax dir, restore the latest step
+    into ``state``'s layout (sharding-preserving). Returns
+    ``(ckpt, state, start_step, done)`` — ``done`` means the checkpoint is
+    already at/past ``iters`` and there is nothing to train."""
+    if checkpoint_dir is None:
+        return None, state, 0, False
+    from ..checkpoint import Checkpointer
+    ckpt = Checkpointer(checkpoint_dir)
+    start_step = 0
+    if ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+        start_step = int(ckpt.latest_step())
+        log_fn(f"resumed from step {start_step}")
+    if start_step >= iters:
+        log_fn(f"checkpoint already at step {start_step} >= iters {iters}; "
+               "nothing to train")
+        ckpt.close()
+        return ckpt, state, start_step, True
+    return ckpt, state, start_step, False
+
+
+def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
+              n_data: int, start_step: int, ckpt, checkpoint_every: int,
+              loss_sink, sink_every: int, log_every: int, log_fn,
+              warmup_steps_excluded: int) -> LLMTrainReport:
+    """The training loop both trainers share: stream replay on resume,
+    per-iteration loss sinking/logging, periodic + final checkpoint saves,
+    and async-honest throughput accounting (the timer starts after
+    ``warmup_steps_excluded`` post-resume steps, on a hard host sync)."""
+    report = LLMTrainReport()
+    last_saved = -1
+    tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
+    t_start = None
+    device_losses = []  # keep losses on device; a float() per step would
+    #                     serialize dispatch and deflate throughput
+    for it in range(train_cfg.iters):
+        host_batch = next(batches).reshape(
+            n_data * train_cfg.batch_size, train_cfg.seq_len)
+        if it < start_step:
+            continue  # resume: replay the stream so data order is preserved
+        state, loss = step_fn(state, shard_fn(host_batch))
+        if it + 1 == start_step + warmup_steps_excluded:
+            float(loss)  # hard sync before starting the timer
+            t_start = time.perf_counter()
+        device_losses.append(loss)
+        if loss_sink is not None and (it % sink_every == 0
+                                      or it == train_cfg.iters - 1):
+            loss_sink(it, float(loss))
+        if log_every and it % log_every == 0:
+            log_fn(f"iter {it}: loss {float(loss):.4f}")
+        if ckpt is not None and (it + 1) % checkpoint_every == 0:
+            ckpt.save(it + 1, state)
+            last_saved = it + 1
+    if ckpt is not None:
+        if train_cfg.iters != last_saved:
+            ckpt.save(train_cfg.iters, state, force=True)
+        ckpt.close()
+    report.losses = [float(l) for l in device_losses]  # syncs the chain
+    report.steps = train_cfg.iters - start_step
+    if t_start is not None and report.steps > warmup_steps_excluded:
+        report.wall_time = time.perf_counter() - t_start
+        timed = report.steps - warmup_steps_excluded
+        report.tokens_per_sec = tokens_per_step * timed / report.wall_time
+    return report
+
+
 def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  train_cfg: Optional[TrainConfig] = None, *,
                  mesh=None,
@@ -75,20 +143,10 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     optimizer = optax.adam(train_cfg.lr)
     state = dp.replicate(mesh, dp.init_state(params, optimizer))
 
-    ckpt = None
-    start_step = 0
-    if checkpoint_dir is not None:
-        from ..checkpoint import Checkpointer
-        ckpt = Checkpointer(checkpoint_dir)
-        if ckpt.latest_step() is not None:
-            state = ckpt.restore(state)
-            start_step = int(ckpt.latest_step())
-            log_fn(f"resumed from step {start_step}")
-        if start_step >= train_cfg.iters:
-            log_fn(f"checkpoint already at step {start_step} >= "
-                   f"iters {train_cfg.iters}; nothing to train")
-            ckpt.close()
-            return LLMTrainReport()
+    ckpt, state, start_step, done = _setup_checkpoint(
+        checkpoint_dir, state, train_cfg.iters, log_fn)
+    if done:
+        return LLMTrainReport()
 
     def loss_fn(p, batch):
         # Fused head+CE: never materializes the [B, T, V] logits (the step's
@@ -103,42 +161,13 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     # Disjoint stream windows per data shard — the reference's skip=rank*5000.
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len, n_data,
                               shard_skip=5000, seed=train_cfg.seed)
-
-    report = LLMTrainReport()
-    last_saved = -1
-    tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
-    t_start = None
-    device_losses = []  # keep losses on device; a float() per step would
-    #                     serialize dispatch and deflate throughput
-    for it in range(train_cfg.iters):
-        host_batch = next(batches).reshape(n_data * train_cfg.batch_size, train_cfg.seq_len)
-        if it < start_step:
-            continue  # resume: replay the stream so data order is preserved
-        batch = dp.shard_batch(mesh, host_batch)
-        state, loss = step_fn(state, batch)
-        if it + 1 == start_step + warmup_steps_excluded:
-            float(loss)  # hard sync before starting the timer
-            t_start = time.perf_counter()
-        device_losses.append(loss)
-        if loss_sink is not None and (it % sink_every == 0
-                                      or it == train_cfg.iters - 1):
-            loss_sink(it, float(loss))
-        if log_every and it % log_every == 0:
-            log_fn(f"iter {it}: loss {float(loss):.4f}")
-        if ckpt is not None and (it + 1) % checkpoint_every == 0:
-            ckpt.save(it + 1, state)
-            last_saved = it + 1
-    if ckpt is not None:
-        if train_cfg.iters != last_saved:
-            ckpt.save(train_cfg.iters, state, force=True)
-        ckpt.close()
-    report.losses = [float(l) for l in device_losses]  # syncs the full chain
-    report.steps = train_cfg.iters - start_step
-    if t_start is not None and train_cfg.iters - start_step > warmup_steps_excluded:
-        report.wall_time = time.perf_counter() - t_start
-        timed_steps = train_cfg.iters - start_step - warmup_steps_excluded
-        report.tokens_per_sec = tokens_per_step * timed_steps / report.wall_time
-    return report
+    return _run_loop(step_fn, state, batches, train_cfg,
+                     lambda b: dp.shard_batch(mesh, b), n_data=n_data,
+                     start_step=start_step, ckpt=ckpt,
+                     checkpoint_every=checkpoint_every, loss_sink=loss_sink,
+                     sink_every=sink_every, log_every=log_every,
+                     log_fn=log_fn,
+                     warmup_steps_excluded=warmup_steps_excluded)
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
@@ -169,8 +198,8 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     preserving — stage-sharded params land back on their stages), skip
     already-completed iterations while still consuming the token stream so
     data order is preserved, save every ``checkpoint_every`` steps and at
-    the end. The loop mirrors train_llm_dp's timing/throughput accounting;
-    keep the two loops' semantics in sync when touching either.
+    the end. Both trainers share one loop implementation (_run_loop), so
+    timing/throughput/resume semantics cannot drift between them.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
@@ -189,56 +218,17 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                                     n_microbatches=train_cfg.microbatches,
                                     schedule=schedule)
 
-    ckpt = None
-    start_step = 0
-    if checkpoint_dir is not None:
-        from ..checkpoint import Checkpointer
-        ckpt = Checkpointer(checkpoint_dir)
-        if ckpt.latest_step() is not None:
-            state = ckpt.restore(state)
-            start_step = int(ckpt.latest_step())
-            log_fn(f"resumed from step {start_step}")
-        if start_step >= train_cfg.iters:
-            log_fn(f"checkpoint already at step {start_step} >= "
-                   f"iters {train_cfg.iters}; nothing to train")
-            ckpt.close()
-            return LLMTrainReport()
+    ckpt, state, start_step, done = _setup_checkpoint(
+        checkpoint_dir, state, train_cfg.iters, log_fn)
+    if done:
+        return LLMTrainReport()
 
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
                               n_data, shard_skip=5000, seed=train_cfg.seed)
-
-    report = LLMTrainReport()
-    last_saved = -1
-    tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
-    t_start = None
-    device_losses = []
-    for it in range(train_cfg.iters):
-        host_batch = next(batches).reshape(
-            n_data * train_cfg.batch_size, train_cfg.seq_len)
-        if it < start_step:
-            continue  # resume: replay the stream so data order is preserved
-        state, loss = step_fn(state, pp.shard_batch(mesh, host_batch))
-        if it + 1 == start_step + warmup_steps_excluded:
-            float(loss)  # hard sync before starting the timer
-            t_start = time.perf_counter()
-        device_losses.append(loss)
-        if loss_sink is not None and (it % sink_every == 0
-                                      or it == train_cfg.iters - 1):
-            loss_sink(it, float(loss))
-        if log_every and it % log_every == 0:
-            log_fn(f"iter {it}: loss {float(loss):.4f}")
-        if ckpt is not None and (it + 1) % checkpoint_every == 0:
-            ckpt.save(it + 1, state)
-            last_saved = it + 1
-    if ckpt is not None:
-        if train_cfg.iters != last_saved:
-            ckpt.save(train_cfg.iters, state, force=True)
-        ckpt.close()
-    report.losses = [float(l) for l in device_losses]
-    report.steps = train_cfg.iters - start_step
-    if t_start is not None and (train_cfg.iters - start_step
-                                > warmup_steps_excluded):
-        report.wall_time = time.perf_counter() - t_start
-        timed = train_cfg.iters - start_step - warmup_steps_excluded
-        report.tokens_per_sec = tokens_per_step * timed / report.wall_time
-    return report
+    return _run_loop(step_fn, state, batches, train_cfg,
+                     lambda b: pp.shard_batch(mesh, b), n_data=n_data,
+                     start_step=start_step, ckpt=ckpt,
+                     checkpoint_every=checkpoint_every, loss_sink=loss_sink,
+                     sink_every=sink_every, log_every=log_every,
+                     log_fn=log_fn,
+                     warmup_steps_excluded=warmup_steps_excluded)
