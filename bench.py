@@ -150,7 +150,12 @@ def main():
         # whether the fused apply beats XLA's fusion on this chip.
         pallas_sweep = [(flash_overrides, "flash-dhm", (32, 64, 128)),
                         ({**flash_overrides, "_opt": "pallas"},
-                         "flash-dhm+padam", (64,))]
+                         "flash-dhm+padam", (64,)),
+                        # bf16 params + fp32-master Adam: halves the weight
+                        # HBM reads of every matmul (ops/mixed_precision.py).
+                        ({**flash_overrides, "param_dtype": "bfloat16",
+                          "_opt": "master"},
+                         "flash-dhm+mp", (64,))]
         for overrides, label, batches in pallas_sweep:
             for bs in batches:
                 try:

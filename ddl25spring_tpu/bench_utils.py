@@ -27,11 +27,16 @@ def make_optimizer(opt_name: str, lr: float = 8e-4):
     optax.adam, asserted ≤1e-6 in tests/test_core.py, fewer HBM round trips
     over the parameter-sized state); "pallas" = the fully-fused Pallas apply
     (ops/pallas_adam.py — moments + param write in one kernel pass per
-    leaf). The optimizer leg is memory-bound either way; benches measure
-    which fusion wins on the chip at hand."""
+    leaf); "master" = fp32-master-weight Adam for bf16 params
+    (ops/mixed_precision.py — pair with ``param_dtype="bfloat16"``). The
+    optimizer leg is memory-bound either way; benches measure which fusion
+    wins on the chip at hand."""
     if opt_name == "pallas":
         from .ops.pallas_adam import FusedApplyAdam
         return FusedApplyAdam(lr)
+    if opt_name == "master":
+        from .ops.mixed_precision import master_weight_adam
+        return master_weight_adam(lr)
     return fused_adam(lr)
 
 

@@ -16,16 +16,18 @@ around one collective; no custom comm code):
   "free" lever on DCN-bound topologies.
 
 - **int8 + error feedback** (``make_int8_ef_grad_step``): per-leaf symmetric
-  quantization to int8 around the shard-group max (pmax-ed so every shard
-  uses the same fixed-point grid), then an **int8 all-gather** — the only
-  collective whose wire operand is the 1-byte tensor — followed by an exact
-  local int32 sum and dequantization. (A psum of the quantized values would
-  be mathematically identical but moves int32 on the wire — zero savings;
-  gathering the int8 shards keeps the wire at 1 byte/element, ~8× fewer
-  bytes than the fp32 allreduce's ≈2×4 bytes/element, at the cost of an
-  n_shards× int8 transient per leaf.) The local quantization residual is
-  fed back into the next step's gradient (error feedback — the standard fix
-  that restores convergence for biased compressors).
+  quantization to int8 around the shard-group max (one pmax of the stacked
+  per-leaf maxima keeps every shard on the same fixed-point grid), then ONE
+  **int8 all-gather of the whole concatenated gradient** — a single
+  collective launch whose wire operand is the 1-byte payload — followed by
+  an exact local int32 sum and per-leaf dequantization. (A psum of the
+  quantized values would be mathematically identical but moves int32 on the
+  wire — zero savings; gathering the int8 payload keeps the wire at
+  1 byte/element, ~8× fewer bytes than the fp32 allreduce's ≈2×4
+  bytes/element, at the cost of an n_shards× int8 transient.) The local
+  quantization residual is fed back into the next step's gradient (error
+  feedback — the standard fix that restores convergence for biased
+  compressors).
 
 Both factories return ``(state, step_fn)`` with the same TrainState the
 plain step uses; the int8 variant carries its residual tree inside an
@@ -43,7 +45,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .dp import TrainState, init_state, replicate
+from .dp import TrainState, apply_optimizer, init_state, replicate
 
 
 def _pmean_bf16(grads, axis: str):
@@ -66,9 +68,8 @@ def make_bf16_grad_step(loss_fn: Callable,
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         grads = _pmean_bf16(grads, "data")
         loss = lax.pmean(loss, "data")
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = optax.apply_updates(state.params, updates)
+        params, opt_state = apply_optimizer(optimizer, grads,
+                                            state.opt_state, state.params)
         return TrainState(params, opt_state, state.step + 1), loss
 
     sharded = jax.shard_map(
@@ -104,12 +105,17 @@ def make_int8_ef_grad_step(loss_fn: Callable,
                            mesh: Mesh) -> Callable:
     """DP step with int8-quantized gradient allreduce + error feedback.
 
-    Per leaf and per step, on each shard: ``c = g_local + residual`` →
-    shared scale ``s = pmax(max|c|)/127`` → ``q = round(c/s)`` (int8 range)
-    → **int8 all-gather** (the wire leg) → exact local int32 sum →
-    ``g_avg = s·Σq/n`` → new residual ``c − s·q``. The optimizer consumes
-    ``g_avg``; the un-transmitted remainder re-enters next step, so the
-    compressor's bias does not accumulate.
+    Per step, on each shard: ``c = g_local + residual`` per leaf → ONE pmax
+    of the stacked per-leaf maxima (shared fixed-point grids, [n_leaves]
+    scalars on the wire) → per-leaf ``q = round(c/s)`` (int8 range) → ONE
+    **int8 all-gather of the concatenated payload** (the wire leg: 1
+    byte/element, and one collective launch regardless of tree size — the
+    per-leaf formulation would pay ~2·n_leaves collective latencies, which
+    is what per-collective-latency-bound DCN topologies cannot afford) →
+    exact local int32 sum → ``g_avg = s·Σq/n`` per leaf → new residual
+    ``c − s·q``. The optimizer consumes ``g_avg``; the un-transmitted
+    remainder re-enters next step, so the compressor's bias does not
+    accumulate.
     """
     n = mesh.shape["data"]
 
@@ -117,32 +123,39 @@ def make_int8_ef_grad_step(loss_fn: Callable,
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         loss = lax.pmean(loss, "data")
 
-        def leaf(g, r_stacked):
-            r = r_stacked[0]          # this shard's [1, ...] slice of the
-            c = g + r                 # stacked residual tree
-            # Shared symmetric scale: pmax keeps every shard's quantizer
-            # identical, so the int32 sum is a faithful fixed-point sum.
-            s = lax.pmax(jnp.max(jnp.abs(c)).astype(jnp.float32),
-                         "data") / 127.0
-            s = jnp.maximum(s, jnp.finfo(jnp.float32).tiny).astype(c.dtype)
-            q = jnp.clip(jnp.round(c / s), -127, 127).astype(jnp.int8)
-            # Wire leg: gather the int8 shards (1 byte/element on the
-            # collective), then sum locally in int32 — exact, and the only
-            # formulation where the moved bytes are actually compressed (a
-            # psum would up-cast the operand to int32 on the wire).
-            gathered = lax.all_gather(q, "data")          # [n, ...] int8
-            total = jnp.sum(gathered.astype(jnp.int32), axis=0)
-            g_avg = (s * total.astype(c.dtype) / n).astype(g.dtype)
-            return g_avg, (c - s * q.astype(c.dtype))[None]
-
         flat_g, treedef = jax.tree.flatten(grads)
-        pairs = [leaf(g, r) for g, r in
-                 zip(flat_g, jax.tree.leaves(state.residual))]
-        g_avg = jax.tree.unflatten(treedef, [p[0] for p in pairs])
-        residual = jax.tree.unflatten(treedef, [p[1] for p in pairs])
-        updates, opt_state = optimizer.update(g_avg, state.opt_state,
-                                              state.params)
-        params = optax.apply_updates(state.params, updates)
+        res = jax.tree.leaves(state.residual)
+        c_leaves = [g + r[0] for g, r in zip(flat_g, res)]
+
+        # One collective for all scales: pmax of the [n_leaves] maxima.
+        local_max = jnp.stack(
+            [jnp.max(jnp.abs(c)).astype(jnp.float32) for c in c_leaves])
+        scales = jnp.maximum(lax.pmax(local_max, "data") / 127.0,
+                             jnp.finfo(jnp.float32).tiny)
+
+        q_leaves = [
+            jnp.clip(jnp.round(c / scales[i].astype(c.dtype)),
+                     -127, 127).astype(jnp.int8)
+            for i, c in enumerate(c_leaves)]
+        # One collective for all payload bytes: gather the concatenated
+        # int8 vector (1 byte/element on the wire; a psum of quantized
+        # values would up-cast the operand to int32 and save nothing).
+        payload = jnp.concatenate([q.reshape(-1) for q in q_leaves])
+        gathered = lax.all_gather(payload, "data")        # [n, N] int8
+        totals = jnp.sum(gathered.astype(jnp.int32), axis=0)
+
+        g_avg_leaves, res_leaves = [], []
+        off = 0
+        for i, (g, c, q) in enumerate(zip(flat_g, c_leaves, q_leaves)):
+            s = scales[i].astype(c.dtype)
+            tot = totals[off:off + g.size].reshape(g.shape)
+            off += g.size
+            g_avg_leaves.append((s * tot.astype(c.dtype) / n).astype(g.dtype))
+            res_leaves.append((c - s * q.astype(c.dtype))[None])
+        g_avg = jax.tree.unflatten(treedef, g_avg_leaves)
+        residual = jax.tree.unflatten(treedef, res_leaves)
+        params, opt_state = apply_optimizer(optimizer, g_avg,
+                                            state.opt_state, state.params)
         return EFTrainState(params, opt_state, state.step + 1, residual), loss
 
     state_specs = EFTrainState(P(), P(), P(), P("data"))

@@ -63,6 +63,18 @@ def sharded_opt_init(mesh: Mesh, params, optimizer: optax.GradientTransformation
     return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
 
 
+def apply_optimizer(optimizer, grads, opt_state, params):
+    """One optimizer application: the duck-typed ``apply_gradients`` fast
+    path when the optimizer provides it (ops.pallas_adam.FusedApplyAdam —
+    one fused kernel pass over {p, m, v, g} instead of update + apply),
+    else the plain optax update. Shared by every step factory that
+    consumes averaged gradients (here and parallel/compress.py)."""
+    if hasattr(optimizer, "apply_gradients"):
+        return optimizer.apply_gradients(params, grads, opt_state)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
+
+
 def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                                mesh: Mesh) -> Callable:
     """jit-compiled SPMD step: local grads -> pmean over ``data`` -> update.
@@ -76,15 +88,8 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         grads = lax.pmean(grads, "data")          # the one collective per iter
         loss = lax.pmean(loss, "data")
-        if hasattr(optimizer, "apply_gradients"):
-            # Fused param+moment apply (ops.pallas_adam.FusedApplyAdam):
-            # one kernel pass over {p, m, v, g} instead of update + apply.
-            params, opt_state = optimizer.apply_gradients(
-                state.params, grads, state.opt_state)
-        else:
-            updates, opt_state = optimizer.update(grads, state.opt_state,
-                                                  state.params)
-            params = optax.apply_updates(state.params, updates)
+        params, opt_state = apply_optimizer(optimizer, grads,
+                                            state.opt_state, state.params)
         return TrainState(params, opt_state, state.step + 1), loss
 
     sharded = jax.shard_map(

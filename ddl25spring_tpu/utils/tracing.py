@@ -99,6 +99,31 @@ class StepTimer:
         return sum(self.times) / max(len(self.times), 1)
 
 
+def atomic_write_csv(path: str, fieldnames: List[str],
+                     rows: List[Dict[str, Any]]) -> None:
+    """Rewrite a CSV atomically: temp file in the same directory +
+    ``os.replace``, preserving the original's mode, with the temp file
+    unlinked on failure. The one implementation of this dance — used by
+    ResultSink's header widening and experiments.common.dedupe_csv, both of
+    which run in environments where processes get killed mid-write."""
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".csv.tmp")
+    try:
+        with os.fdopen(fd, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=fieldnames, restval="")
+            writer.writeheader()
+            writer.writerows(rows)
+        if os.path.exists(path):
+            os.chmod(tmp, os.stat(path).st_mode & 0o7777)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
 class ResultSink:
     """Append-only CSV sink for experiment records.
 
@@ -130,21 +155,13 @@ class ResultSink:
         if extra:
             # Widen: rewrite the file under the union header instead of
             # silently dropping the new fields. Pure-csv round-trip (no type
-            # inference mangling existing values) via a temp file + atomic
-            # replace so a crash mid-widen cannot lose prior records.
+            # inference mangling existing values), atomic so a crash
+            # mid-widen cannot lose prior records.
             self._fieldnames = self._fieldnames + extra
             if os.path.exists(self.path):
-                import tempfile
                 with open(self.path, newline="") as f:
                     old_rows = list(csv.DictReader(f))
-                fd, tmp = tempfile.mkstemp(
-                    dir=os.path.dirname(self.path) or ".", suffix=".csv")
-                with os.fdopen(fd, "w", newline="") as f:
-                    writer = csv.DictWriter(f, fieldnames=self._fieldnames,
-                                            restval="")
-                    writer.writeheader()
-                    writer.writerows(old_rows)
-                os.replace(tmp, self.path)
+                atomic_write_csv(self.path, self._fieldnames, old_rows)
         with open(self.path, "a", newline="") as f:
             writer = csv.DictWriter(f, fieldnames=self._fieldnames,
                                     restval="")

@@ -43,17 +43,10 @@ def dedupe_csv(path: str, key_cols: List[str]) -> int:
         seen.add(k)
         kept.append(r)
     if len(kept) < len(rows):
-        # Atomic replace (the pattern in utils/tracing.ResultSink): this is
-        # called by the watchdog in an environment where processes get
-        # killed — a truncating in-place rewrite could lose the whole CSV.
-        import tempfile
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
-                                   suffix=".dedupe")
-        with os.fdopen(fd, "w", newline="") as f:
-            w = csv.DictWriter(f, fieldnames=rows[0].keys())
-            w.writeheader()
-            w.writerows(kept)
-        os.replace(tmp, path)
+        # Atomic: this runs in the watchdog's kill-prone environment — a
+        # truncating in-place rewrite could lose the whole CSV.
+        from ddl25spring_tpu.utils.tracing import atomic_write_csv
+        atomic_write_csv(path, list(rows[0].keys()), kept)
     return len(rows) - len(kept)
 
 

@@ -75,11 +75,17 @@ def test_wire_dtypes_in_compiled_program():
         "bf16[64]" in jaxpr.replace("bfloat16", "bf16"), \
         "no bf16 gradient collective found in the bf16-wire step"
 
+    # Two leaves: the payload must ride ONE concatenated all_gather, not
+    # one collective per leaf.
+    params = {**params, "extra": jnp.zeros((32,))}
     state = compress.init_ef_state(mesh, params, opt)
     jaxpr8 = str(jax.make_jaxpr(
         lambda s, b: compress.make_int8_ef_grad_step(loss_fn, opt, mesh)(s, b))(
             state, dp.shard_batch(mesh, batch)))
     import re
+    n_gathers = len(re.findall(r"= all_gather\[", jaxpr8))
+    assert n_gathers == 1, \
+        f"expected one concatenated all_gather eqn, found {n_gathers}"
     # The gradient's collective is an all_gather of an i8 operand...
     assert re.search(r"all_gather\S*\s[a-z]+:i8\[", jaxpr8) or \
         re.search(r":i8\[64\][^\n]*\n[^\n]*all_gather", jaxpr8) or \
