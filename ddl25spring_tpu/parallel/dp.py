@@ -76,16 +76,46 @@ def apply_optimizer(optimizer, grads, opt_state, params):
 
 
 def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
-                               mesh: Mesh) -> Callable:
+                               mesh: Mesh, accum_steps: int = 1) -> Callable:
     """jit-compiled SPMD step: local grads -> pmean over ``data`` -> update.
 
     ``loss_fn(params, batch) -> scalar``. The batch's leading axis is sharded
     over ``data``; params/opt state are replicated and stay bitwise-identical
     across shards because every shard applies the same averaged gradient.
+
+    ``accum_steps > 1`` enables gradient accumulation: each shard's local
+    batch is split into ``accum_steps`` microbatches scanned sequentially,
+    their gradients averaged before the ONE pmean + update — an
+    ``accum_steps``-times larger effective batch at one microbatch's
+    activation memory, with unchanged collective traffic. The local batch's
+    leading dim must divide evenly. Equivalent to the full-batch step up to
+    float re-association (asserted in tests/test_dp.py).
     """
 
     def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        else:
+            micro = batch.reshape((accum_steps, -1) + batch.shape[1:])
+
+            def body(carry, mb):
+                loss_sum, gsum = carry
+                l, g = jax.value_and_grad(loss_fn)(state.params, mb)
+                # Accumulate in fp32 regardless of param/grad dtype: a bf16
+                # running sum would round away small microbatch
+                # contributions (the vanishing-accumulation failure mode
+                # ops/mixed_precision.py exists to fix).
+                gsum = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
+                return (loss_sum + l.astype(jnp.float32), gsum), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss, gsum), _ = lax.scan(body, (jnp.zeros(()), zeros), micro)
+            loss = loss / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                gsum, state.params)
         grads = lax.pmean(grads, "data")          # the one collective per iter
         loss = lax.pmean(loss, "data")
         params, opt_state = apply_optimizer(optimizer, grads,

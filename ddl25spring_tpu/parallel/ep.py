@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import MoEConfig
 from ..models import moe
 from ..ops import causal_lm_loss
-from .dp import TrainState, sharded_opt_init
+from .dp import TrainState, apply_optimizer, sharded_opt_init
 
 _EXPERT_LEAVES = {"w_gate", "w_up", "w_down"}   # leading [L, E, ...] axis
 
@@ -98,8 +98,8 @@ def make_ep_train_step(cfg: MoEConfig, optimizer: optax.GradientTransformation,
             out_specs=(P(), pspecs),
             check_vma=False,
         )(state.params, tokens)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        params, opt_state = apply_optimizer(optimizer, grads,
+                                            state.opt_state, state.params)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return jax.jit(step, donate_argnums=(0,))

@@ -48,7 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import LlamaConfig
 from ..models import llama
-from .dp import TrainState, sharded_opt_init
+from .dp import TrainState, apply_optimizer, sharded_opt_init
 
 
 # ------------------------------------------------------------- param layout
@@ -496,8 +496,8 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
             out_specs=(P(), specs),
             check_vma=False,
         )(state.params, tokens)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        params, opt_state = apply_optimizer(optimizer, grads,
+                                            state.opt_state, state.params)
         if _LAYOUT_KEY in params:
             # Keep the layout tag exactly invariant under ANY optimizer —
             # zero grad does not protect it from params-coupled transforms

@@ -1,0 +1,22 @@
+#!/bin/sh
+# One-shot TPU evidence capture — run when the tunnel is alive (probe first:
+#   python -c "from ddl25spring_tpu.utils.probe import probe_default_platform as p; print(p())"
+# ). The tunnel dies unpredictably, so this serializes every measurement
+# into a single session and logs everything under experiments/results/.
+#   1. bench.py          — headline sweep (flash-dhm batches, pallas-Adam,
+#                          mixed-precision, XLA comparison points, decode)
+#   2. longctx_bench     — train-step throughput across T=256..8192
+# Each stage is already subprocess-isolated + hard-timeout wedge-proofed
+# internally, so a mid-stage tunnel death loses only that stage.
+set -x
+cd "$(dirname "$0")/.."
+TS=$(date -u +%Y%m%dT%H%M%S)
+LOG=experiments/results/tpu_evidence_${TS}.log
+{
+  echo "=== bench.py $(date -u) ==="
+  python bench.py
+  echo "=== longctx_bench $(date -u) ==="
+  python -m experiments.longctx_bench
+  echo "=== done $(date -u) ==="
+} > "$LOG" 2>&1
+tail -5 "$LOG"

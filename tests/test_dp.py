@@ -134,3 +134,52 @@ def test_zero1_matches_grad_aggregation(devices):
                     jax.tree.leaves(z_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-6, rtol=1e-5)
+
+
+def test_grad_accumulation_matches_full_batch(devices):
+    """accum_steps=2 microbatched gradients equal the full-batch step up to
+    float re-association: same pmean, same update, K× less activation
+    memory (dp.make_grad_aggregation_step accum_steps)."""
+    mesh = make_mesh({"data": 2}, devices=devices[:2])
+    batch = jax.random.randint(jax.random.key(1), (8, 8), 0, 64)
+
+    opt = optax.adam(1e-3)
+    full_state = dp.replicate(mesh, dp.init_state(
+        llama.init_llama(jax.random.key(0), TINY), opt))
+    acc_state = dp.replicate(mesh, dp.init_state(
+        llama.init_llama(jax.random.key(0), TINY), opt))
+    full_step = dp.make_grad_aggregation_step(_loss_fn, opt, mesh)
+    acc_step = dp.make_grad_aggregation_step(_loss_fn, opt, mesh,
+                                             accum_steps=2)
+    for _ in range(3):
+        full_state, full_loss = full_step(full_state,
+                                          dp.shard_batch(mesh, batch))
+        acc_state, acc_loss = acc_step(acc_state, dp.shard_batch(mesh, batch))
+        np.testing.assert_allclose(float(acc_loss), float(full_loss),
+                                   rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(full_state.params),
+                    jax.tree.leaves(acc_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=1e-4)
+
+
+def test_grad_accum_uses_fp32_accumulator_for_bf16_params():
+    """512 microbatches each contributing gradient exactly t=2^-12: the fp32
+    accumulator sums them to 512*t = 0.125 exactly (every partial sum is
+    representable), so the averaged grad is exactly t and one sgd(1.0) step
+    lands at -t. A bf16 accumulator starts rounding partial sums past 256*t
+    (9 mantissa bits needed) and misses — the vanishing-accumulation mode
+    the fp32 carry exists to prevent."""
+    mesh = make_mesh({"data": 1})
+    t = 2.0 ** -12
+    params = {"w": jnp.zeros((), jnp.bfloat16)}
+    batch = jnp.full((512, 1), t, jnp.bfloat16)
+
+    def loss_fn(p, b):
+        return (p["w"].astype(jnp.float32) * b.astype(jnp.float32)).mean()
+
+    opt = optax.sgd(1.0)
+    state = dp.replicate(mesh, dp.init_state(params, opt))
+    step = dp.make_grad_aggregation_step(loss_fn, opt, mesh, accum_steps=512)
+    state, _ = step(state, dp.shard_batch(mesh, batch))
+    assert float(state.params["w"]) == -t, float(state.params["w"])
