@@ -144,6 +144,14 @@ class TrainConfig:
     seq: int = 1                   # sequence/context parallel degree
     microbatches: int = 1          # GPipe microbatches per step (PP)
     remat: bool = False            # jax.checkpoint on transformer blocks
+    # Optimizer: "adam" (optax, the reference's), "fused" (ops/adam.py
+    # single-pass), "pallas" (ops/pallas_adam.py fused apply), "master"
+    # (ops/mixed_precision.py — pair with LlamaConfig param_dtype bf16).
+    optimizer: str = "adam"
+    # Gradient-allreduce wire format for the DP trainer: "fp32" (plain
+    # pmean), "bf16" or "int8_ef" (parallel/compress.py).
+    wire: str = "fp32"
+    accum_steps: int = 1           # DP gradient accumulation (dp.py)
 
 
 @dataclass(frozen=True)

@@ -36,6 +36,16 @@ class LLMTrainReport:
         return self.tokens_per_sec / max(n_devices, 1)
 
 
+def _make_trainer_optimizer(train_cfg: TrainConfig):
+    """TrainConfig.optimizer -> optimizer instance, shared by both trainers:
+    "adam" is the reference's plain optax.adam; everything else dispatches
+    through bench_utils.make_optimizer ("fused"/"pallas"/"master")."""
+    if train_cfg.optimizer == "adam":
+        return optax.adam(train_cfg.lr)
+    from ..bench_utils import make_optimizer
+    return make_optimizer(train_cfg.optimizer, train_cfg.lr)
+
+
 def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
                       log_fn: Callable[[str], None]):
     """Shared resume preamble: open the orbax dir, restore the latest step
@@ -140,13 +150,7 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     n_data = mesh.shape.get("data", 1)
 
     params = llama.init_llama(jax.random.key(train_cfg.seed), model_cfg)
-    optimizer = optax.adam(train_cfg.lr)
-    state = dp.replicate(mesh, dp.init_state(params, optimizer))
-
-    ckpt, state, start_step, done = _setup_checkpoint(
-        checkpoint_dir, state, train_cfg.iters, log_fn)
-    if done:
-        return LLMTrainReport()
+    optimizer = _make_trainer_optimizer(train_cfg)
 
     def loss_fn(p, batch):
         # Fused head+CE: never materializes the [B, T, V] logits (the step's
@@ -154,9 +158,39 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         # causal_lm_loss(llama.forward(...)) — asserted in tests/test_core.py.
         return llama.forward_loss(p, batch, model_cfg)
 
-    make_step = (dp.make_grad_aggregation_step if aggregation == "gradient"
-                 else dp.make_weight_aggregation_step)
-    step_fn = make_step(loss_fn, optimizer, mesh)
+    state = dp.replicate(mesh, dp.init_state(params, optimizer))
+    if train_cfg.wire != "fp32":
+        # Compressed gradient allreduce (parallel/compress.py) — gradient
+        # aggregation only, and accumulation stays at 1 (the compressed
+        # steps own their collective schedule). Hard errors, not asserts:
+        # a stripped assert (python -O) would silently run the wrong
+        # aggregation algorithm.
+        if aggregation != "gradient" or train_cfg.accum_steps != 1:
+            raise ValueError(
+                "wire compression requires gradient aggregation without "
+                f"accumulation (got aggregation={aggregation!r}, "
+                f"accum_steps={train_cfg.accum_steps})")
+        from ..parallel import compress
+        if train_cfg.wire == "bf16":
+            step_fn = compress.make_bf16_grad_step(loss_fn, optimizer, mesh)
+        elif train_cfg.wire == "int8_ef":
+            state = compress.init_ef_state(mesh, params, optimizer)
+            step_fn = compress.make_int8_ef_grad_step(loss_fn, optimizer,
+                                                      mesh)
+        else:
+            raise ValueError(f"unknown wire format {train_cfg.wire!r}")
+    elif aggregation == "gradient":
+        step_fn = dp.make_grad_aggregation_step(
+            loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
+    else:
+        if train_cfg.accum_steps != 1:
+            raise ValueError("accum_steps needs gradient aggregation")
+        step_fn = dp.make_weight_aggregation_step(loss_fn, optimizer, mesh)
+
+    ckpt, state, start_step, done = _setup_checkpoint(
+        checkpoint_dir, state, train_cfg.iters, log_fn)
+    if done:
+        return LLMTrainReport()
 
     # Disjoint stream windows per data shard — the reference's skip=rank*5000.
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len, n_data,
@@ -204,12 +238,15 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
     train_cfg = train_cfg or TrainConfig()
+    if train_cfg.wire != "fp32":
+        raise ValueError("wire compression (TrainConfig.wire) is DP-trainer-"
+                         "only; the pipeline step owns its own collectives")
     mesh = mesh or make_mesh({"data": train_cfg.data,
                               "stage": train_cfg.stage})
     n_data = mesh.shape.get("data", 1)
 
     params = llama.init_llama(jax.random.key(train_cfg.seed), model_cfg)
-    optimizer = optax.adam(train_cfg.lr)
+    optimizer = _make_trainer_optimizer(train_cfg)
     if schedule == "interleaved":
         params = pp.interleave_params(params, mesh.shape["stage"],
                                       n_chunks=2)
