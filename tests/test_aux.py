@@ -271,3 +271,28 @@ def test_train_llm_pp_checkpoint_resume(tmp_path):
                                rtol=2e-5)
     assert [it for it, _ in sunk] == [0, 1, 2]  # absolute iteration indices
     np.testing.assert_allclose([l for _, l in sunk], first.losses, rtol=1e-6)
+
+
+def test_atomic_write_csv_and_dedupe(tmp_path):
+    """atomic_write_csv preserves mode and cleans its temp file on failure;
+    dedupe_csv drops retried-segment duplicates keeping first occurrence
+    (the watchdog-resume overlap case)."""
+    import os
+
+    from ddl25spring_tpu.utils.tracing import atomic_write_csv
+    from experiments.common import dedupe_csv
+
+    p = tmp_path / "r.csv"
+    p.write_text("config,iter,loss\na,0,1.0\na,10,0.9\na,10,0.9\na,20,0.8\n")
+    os.chmod(p, 0o640)
+    assert dedupe_csv(str(p), ["config", "iter"]) == 1
+    assert p.read_text() == "config,iter,loss\na,0,1.0\na,10,0.9\na,20,0.8\n"
+    assert (os.stat(p).st_mode & 0o777) == 0o640  # mode preserved
+
+    # Failure path: a non-serializable row raises inside the writer; the
+    # original file must be untouched and no temp file left behind.
+    before = p.read_text()
+    with pytest.raises(ValueError):
+        atomic_write_csv(str(p), ["x"], [{"x": 1, "unknown_field": 2}])
+    assert p.read_text() == before
+    assert [f for f in os.listdir(tmp_path) if f != "r.csv"] == []
