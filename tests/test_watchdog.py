@@ -1,0 +1,52 @@
+"""experiments/watchdog.py end-to-end: stall detection, relaunch, resume.
+
+Uses a scripted fake trainer that streams rows to a progress CSV, persists
+its position, and wedges (sleeps forever) partway through its FIRST attempt
+only — the watchdog must detect the stall via file-growth, kill, relaunch,
+and the resumed run must complete the contiguous record.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAKE_TRAINER = textwrap.dedent("""
+    import os, sys, time
+    d = sys.argv[1]
+    state = os.path.join(d, "state.txt")
+    prog = os.path.join(d, "progress.csv")
+    start = int(open(state).read()) if os.path.exists(state) else 0
+    if not os.path.exists(prog):
+        with open(prog, "w") as f:
+            f.write("iter,val\\n")
+    for it in range(start, 20):
+        with open(prog, "a") as f:
+            f.write(f"{it},{it * 2}\\n")
+        with open(state, "w") as f:
+            f.write(str(it + 1))
+        if it == 7 and not os.path.exists(os.path.join(d, "wedged_once")):
+            open(os.path.join(d, "wedged_once"), "w").close()
+            time.sleep(100000)   # the wedge
+        time.sleep(0.1)
+    print("done")
+""")
+
+
+def test_watchdog_kills_stall_and_resumes(tmp_path):
+    fake = tmp_path / "fake_train.py"
+    fake.write_text(FAKE_TRAINER)
+    prog = tmp_path / "progress.csv"
+    proc = subprocess.run(
+        [sys.executable, "-m", "experiments.watchdog",
+         "--progress", str(prog), "--stall-min", "0.02",
+         "--dedupe-keys", "iter", "--max-restarts", "3", "--",
+         sys.executable, str(fake), str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "killing pid" in proc.stdout          # the stall was detected
+    rows = prog.read_text().strip().splitlines()
+    iters = [int(r.split(",")[0]) for r in rows[1:]]
+    assert iters == list(range(20)), iters       # contiguous after resume
