@@ -1,0 +1,62 @@
+"""DP training with the efficiency levers: fused/Pallas/mixed-precision
+optimizers, compressed gradient allreduce, gradient accumulation.
+
+(No reference counterpart — the reference trains fp32 torch modules with a
+full-precision gloo allreduce.) One flag each for the levers the framework
+adds on top of the reference's DP recipe:
+
+- ``--optimizer {adam,fused,pallas,master}`` — optax baseline, single-pass
+  fused Adam (ops/adam.py), the fully-fused Pallas apply (ops/pallas_adam),
+  or fp32-master-weight Adam for bf16 params (ops/mixed_precision.py;
+  implies ``param_dtype=bfloat16``)
+- ``--wire {fp32,bf16,int8_ef}`` — gradient-allreduce wire format
+  (parallel/compress.py)
+- ``--accum N`` — gradient accumulation (N microbatches per step);
+  mutually exclusive with wire compression (the compressed steps own
+  their collective schedule)
+
+    python examples/efficient_dp.py --cpu-devices 4 --iters 100 \
+        --optimizer master --wire bf16
+"""
+
+from _common import base_parser, repo_on_path, setup_devices
+
+repo_on_path()
+
+
+def main():
+    ap = base_parser(iters=200, batch=4)
+    ap.add_argument("--optimizer", default="fused",
+                    choices=["adam", "fused", "pallas", "master"])
+    ap.add_argument("--wire", default="fp32",
+                    choices=["fp32", "bf16", "int8_ef"])
+    ap.add_argument("--accum", type=int, default=1)
+    args = ap.parse_args()
+    if args.wire != "fp32" and args.accum != 1:
+        ap.error("--wire compression and --accum are mutually exclusive "
+                 "(the compressed steps own their collective schedule)")
+    setup_devices(args)
+    import jax
+
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.train.llm import train_llm_dp
+
+    n = len(jax.devices())
+    model_cfg = LlamaConfig(
+        dtype="bfloat16",
+        param_dtype="bfloat16" if args.optimizer == "master" else "float32")
+    report = train_llm_dp(
+        model_cfg,
+        TrainConfig(iters=args.iters, batch_size=args.batch, data=n,
+                    optimizer=args.optimizer, wire=args.wire,
+                    accum_steps=args.accum),
+        mesh=make_mesh({"data": n}),
+        log_every=max(1, args.iters // 20))
+    print(f"final loss {report.losses[-1]:.4f}  "
+          f"{report.tokens_per_sec:.0f} tok/s over {n} device(s)  "
+          f"[opt={args.optimizer} wire={args.wire} accum={args.accum}]")
+
+
+if __name__ == "__main__":
+    main()
