@@ -296,3 +296,21 @@ def test_atomic_write_csv_and_dedupe(tmp_path):
         atomic_write_csv(str(p), ["x"], [{"x": 1, "unknown_field": 2}])
     assert p.read_text() == before
     assert [f for f in os.listdir(tmp_path) if f != "r.csv"] == []
+
+
+def test_eval_llm_heldout():
+    """eval_llm: finite loss/perplexity on a disjoint stream window; an
+    untrained model scores ≈ ln(vocab) (the uniform-softmax line)."""
+    import math
+
+    from ddl25spring_tpu.tokenizers import load_tokenizer
+    from ddl25spring_tpu.train.llm import eval_llm
+
+    cfg = LlamaConfig(dmodel=16, num_heads=2, n_layers=2, ctx_size=16)
+    tok = load_tokenizer()
+    untrained = llama.init_llama(jax.random.key(7),
+                                 cfg.replace(vocab_size=tok.vocab_size))
+    m = eval_llm(untrained, cfg, n_batches=2, batch_size=2, skip=0)
+    assert np.isfinite(m["loss"]) and m["perplexity"] > 1
+    assert abs(m["loss"] - math.log(tok.vocab_size)) < 1.0
+    assert m["n_tokens"] == 2 * 2 * 16
