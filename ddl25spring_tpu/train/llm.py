@@ -47,7 +47,7 @@ def _eval_batch_loss(params, batch, cfg: LlamaConfig):
 
 def eval_llm(params, model_cfg: LlamaConfig, *, n_batches: int = 16,
              batch_size: int = 8, skip: int = 0,
-             tokenizer=None, seed: int = 1) -> dict:
+             tokenizer=None, seed: int = 1, stream=None) -> dict:
     """Held-out evaluation: mean next-token loss and perplexity over
     ``n_batches``. Parity-plus: the reference only ever prints train-batch
     loss (lab/tutorial_1b/primer/intro.py); an eval split is what lets a
@@ -61,19 +61,26 @@ def eval_llm(params, model_cfg: LlamaConfig, *, n_batches: int = 16,
     file-backed corpus pass ``skip`` explicitly, PAST your training window
     (trainer shard i reads from sequence i·5000 for iters·batch_size
     sequences) — and note the stream cycles a short corpus, so disjointness
-    holds only while skip + the eval span stays within one pass.
+    holds only while skip + the eval span stays within one pass. For
+    periodic evals with a nonzero skip, build the stream once and pass it
+    via ``stream`` — each call then continues the iterator instead of
+    re-tokenizing the whole skip window.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = model_cfg.replace(vocab_size=tok.vocab_size)
-    stream = iter(TokenStream(tok, batch_size, model_cfg.ctx_size,
-                              skip=skip, seed=seed))
+    if stream is None:
+        stream = iter(TokenStream(tok, batch_size, model_cfg.ctx_size,
+                                  skip=skip, seed=seed))
     total = 0.0
+    n_tokens = 0
     for _ in range(n_batches):
-        total += float(_eval_batch_loss(params, jnp.asarray(next(stream)),
-                                        model_cfg))
+        batch = jnp.asarray(next(stream))
+        total += float(_eval_batch_loss(params, batch, model_cfg))
+        # The causal loss scores T-1 next-token positions per sequence.
+        n_tokens += batch.shape[0] * (batch.shape[1] - 1)
     mean = total / n_batches
     return {"loss": mean, "perplexity": math.exp(min(mean, 30.0)),
-            "n_tokens": n_batches * batch_size * model_cfg.ctx_size}
+            "n_tokens": n_tokens}
 
 
 def _make_trainer_optimizer(train_cfg: TrainConfig):

@@ -313,4 +313,4 @@ def test_eval_llm_heldout():
     m = eval_llm(untrained, cfg, n_batches=2, batch_size=2, skip=0)
     assert np.isfinite(m["loss"]) and m["perplexity"] > 1
     assert abs(m["loss"] - math.log(tok.vocab_size)) < 1.0
-    assert m["n_tokens"] == 2 * 2 * 16
+    assert m["n_tokens"] == 2 * 2 * (16 - 1)  # T-1 scored positions/sequence

@@ -1,0 +1,124 @@
+"""DP-FedAvg (fl/privacy.py): clipping, noise calibration, accounting.
+
+Pins: the clip actually bounds per-client contributions; zero-noise +
+infinite-clip DP-FedAvg equals a uniform-weight FedAvg round; the injected
+noise has the calibrated per-coordinate std; training still learns under
+moderate noise; epsilon accounting is monotone in the right directions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.config import FLConfig
+from ddl25spring_tpu.data import mnist
+from ddl25spring_tpu.fl import federate
+from ddl25spring_tpu.fl.privacy import (DPFedAvgServer, clip_by_global_norm,
+                                        dp_epsilon, gaussian_noise_like)
+from ddl25spring_tpu.models import mnist_cnn
+from ddl25spring_tpu.utils import pytree as pt
+
+
+@pytest.fixture(scope="module")
+def fl_setup():
+    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=1000, n_test=300, seed=0)
+    x = mnist.normalize(x_raw)
+    xt = mnist.normalize(xt_raw)
+    cfg = FLConfig(nr_clients=10, client_fraction=0.3, batch_size=50,
+                   epochs=1, lr=0.05, rounds=2, seed=10)
+    subsets = mnist.split(y, cfg.nr_clients, iid=True, seed=cfg.seed)
+    data = federate(x, y.astype(np.int32), subsets)
+    params = mnist_cnn.init(jax.random.key(0))
+    return params, data, xt, yt.astype(np.int32), cfg
+
+
+def test_clip_by_global_norm():
+    tree = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}  # norm 10
+    clipped = clip_by_global_norm(tree, 5.0)
+    np.testing.assert_allclose(float(pt.global_norm(clipped)), 5.0, rtol=1e-6)
+    small = clip_by_global_norm(tree, 100.0)  # within bound: identity
+    np.testing.assert_allclose(np.asarray(small["a"]), 3.0)
+
+
+def test_noise_std_calibration():
+    tree = {"w": jnp.zeros((20_000,))}
+    noisy = gaussian_noise_like(jax.random.key(0), tree, sigma=0.25)
+    assert abs(float(noisy["w"].std()) - 0.25) < 0.01
+
+
+def test_zero_noise_infinite_clip_is_uniform_fedavg(fl_setup):
+    params, data, xt, yt, cfg = fl_setup
+    a = DPFedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                       clip_norm=None, noise_multiplier=0.0)
+    b = DPFedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                       clip_norm=1e9, noise_multiplier=0.0)
+    ra = a.run(nr_rounds=2)
+    rb = b.run(nr_rounds=2)
+    # A huge finite clip never binds, so the two runs are identical.
+    np.testing.assert_allclose(ra.test_accuracy, rb.test_accuracy, atol=1e-6)
+
+
+def test_dp_fedavg_learns_under_clipping(fl_setup):
+    """Pure clipping (z=0) still learns — slower than unclipped, but the
+    direction survives the norm bound. (Utility under MEANINGFUL noise
+    needs realistic cohort sizes: σ = z·S/m per coordinate, so with the
+    test's m=3 sampled clients any useful z swamps the ~1e-3-magnitude
+    update coordinates — true to the mechanism, not a bug; real DP-FedAvg
+    runs sample hundreds+ of clients.)"""
+    params, data, xt, yt, cfg = fl_setup
+    server = DPFedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                            clip_norm=5.0, noise_multiplier=0.0)
+    res = server.run(nr_rounds=5)
+    assert res.test_accuracy[-1] > 0.25  # above the 10% chance line
+
+
+def test_dp_fedavg_noise_perturbs_calibratedly(fl_setup):
+    """With noise on, the first-round aggregate differs from the noiseless
+    one by a perturbation whose scale matches sigma = z*S/m."""
+    params, data, xt, yt, cfg = fl_setup
+    clean = DPFedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                           clip_norm=5.0, noise_multiplier=0.0)
+    noisy = DPFedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg,
+                           clip_norm=5.0, noise_multiplier=0.3)
+    ra = clean.run(nr_rounds=1)
+    rb = noisy.run(nr_rounds=1)
+    diff = [np.asarray(a) - np.asarray(b) for a, b in
+            zip(jax.tree.leaves(clean.params), jax.tree.leaves(noisy.params))]
+    flat = np.concatenate([d.ravel() for d in diff])
+    del ra, rb
+    sigma = 0.3 * 5.0 / max(1, int(cfg.nr_clients * cfg.client_fraction))
+    assert abs(flat.std() - sigma) / sigma < 0.1
+
+
+def test_dp_epsilon_monotone():
+    assert dp_epsilon(1.0, 10) > dp_epsilon(2.0, 10)    # more noise, less ε
+    assert dp_epsilon(1.0, 100) > dp_epsilon(1.0, 10)   # more rounds, more ε
+    assert dp_epsilon(0.0, 1) == float("inf")
+    assert 0 < dp_epsilon(1.0, 1, delta=1e-5) < 10
+
+
+def test_noise_fresh_every_round(fl_setup):
+    """With lr=0 every delta is zero, so each round's param change is
+    exactly the (negated) noise tree: consecutive rounds must add
+    DIFFERENT noise. Regression pin for the noise-key derivation — keys
+    built from the reference's linear per-client seed formula collide
+    across rounds, which would repeat the exact noise vector and void the
+    Gaussian composition the accounting assumes."""
+    import dataclasses
+
+    params, data, xt, yt, cfg = fl_setup
+    cfg0 = dataclasses.replace(cfg, lr=0.0)
+    server = DPFedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg0,
+                            clip_norm=5.0, noise_multiplier=0.3)
+    p0 = jax.tree.map(np.asarray, server.params)
+    p1 = jax.tree.map(np.asarray, server._round(server.params, 0))
+    p2 = jax.tree.map(np.asarray, server._round(p1, 1))
+    n1 = np.concatenate([(a - b).ravel() for a, b in
+                         zip(jax.tree.leaves(p1), jax.tree.leaves(p0))])
+    n2 = np.concatenate([(a - b).ravel() for a, b in
+                         zip(jax.tree.leaves(p2), jax.tree.leaves(p1))])
+    sigma = 0.3 * 5.0 / max(1, int(cfg.nr_clients * cfg.client_fraction))
+    assert abs(np.std(n1) - sigma) / sigma < 0.1
+    assert abs(np.std(n2) - sigma) / sigma < 0.1
+    assert np.abs(n1 - n2).max() > sigma  # distinct noise vectors
