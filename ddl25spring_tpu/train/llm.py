@@ -62,15 +62,18 @@ def eval_llm(params, model_cfg: LlamaConfig, *, n_batches: int = 16,
     (trainer shard i reads from sequence i·5000 for iters·batch_size
     sequences) — and note the stream cycles a short corpus, so disjointness
     holds only while skip + the eval span stays within one pass. For
-    periodic evals with a nonzero skip, build the stream once and pass it
-    via ``stream`` — each call then continues the iterator instead of
-    re-tokenizing the whole skip window.
+    periodic evals with a nonzero skip, build the iterator once —
+    ``it = iter(TokenStream(...))`` — and pass it via ``stream``: each call
+    then continues it instead of re-tokenizing the whole skip window. (A
+    raw TokenStream is also accepted but restarts — and re-pays the skip —
+    on every call.)
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = model_cfg.replace(vocab_size=tok.vocab_size)
     if stream is None:
-        stream = iter(TokenStream(tok, batch_size, model_cfg.ctx_size,
-                                  skip=skip, seed=seed))
+        stream = TokenStream(tok, batch_size, model_cfg.ctx_size,
+                             skip=skip, seed=seed)
+    stream = iter(stream)  # no-op on iterators; accepts a raw TokenStream
     total = 0.0
     n_tokens = 0
     for _ in range(n_batches):

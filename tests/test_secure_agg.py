@@ -1,0 +1,115 @@
+"""Secure aggregation (fl/secure_agg.py): exact cancellation, masking, E2E.
+
+Pins: pairwise masks cancel EXACTLY in the wrapped int32 sum (the property
+floating-point masking cannot give); a single masked upload is
+full-range-uniform (the server learns nothing from one upload beyond the
+modular sum); the secure round equals the plain clipped round up to the
+fixed-point grid; training works end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.config import FLConfig
+from ddl25spring_tpu.data import mnist
+from ddl25spring_tpu.fl import federate
+from ddl25spring_tpu.fl.privacy import DPFedAvgServer
+from ddl25spring_tpu.fl.secure_agg import (SecureAggFedAvgServer, _pair_key,
+                                           dequantize_tree, mask_tree,
+                                           quantize_tree)
+
+
+@pytest.fixture(scope="module")
+def fl_setup():
+    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=1000, n_test=300, seed=0)
+    x = mnist.normalize(x_raw)
+    xt = mnist.normalize(xt_raw)
+    cfg = FLConfig(nr_clients=10, client_fraction=0.3, batch_size=50,
+                   epochs=1, lr=0.05, rounds=2, seed=10)
+    subsets = mnist.split(y, cfg.nr_clients, iid=True, seed=cfg.seed)
+    data = federate(x, y.astype(np.int32), subsets)
+    params = mnist_cnn_init()
+    return params, data, xt, yt.astype(np.int32), cfg
+
+
+def mnist_cnn_init():
+    from ddl25spring_tpu.models import mnist_cnn
+    return mnist_cnn.init(jax.random.key(0))
+
+
+def test_pairwise_masks_cancel_exactly():
+    """Three clients' manually-masked int32 trees sum (wrapped) to exactly
+    the unmasked sum — the core SecAgg identity."""
+    root = jax.random.key(7)
+    gids = jnp.asarray([2, 5, 9])
+    trees = [{"w": jax.random.randint(jax.random.key(i), (64,), -1000, 1000,
+                                      dtype=jnp.int32)} for i in range(3)]
+
+    def masked(i):
+        t = trees[i]
+        for j in range(3):
+            if j == i:
+                continue
+            m = mask_tree(_pair_key(root, gids[i], gids[j], 0), t)
+            sign = 1 if int(gids[i]) < int(gids[j]) else -1
+            t = jax.tree.map(lambda a, mm: a + sign * mm, t, m)
+        return t
+
+    total_masked = jax.tree.map(lambda *xs: sum(xs), *[masked(i)
+                                                       for i in range(3)])
+    total_plain = jax.tree.map(lambda *xs: sum(xs), *trees)
+    np.testing.assert_array_equal(np.asarray(total_masked["w"]),
+                                  np.asarray(total_plain["w"]))
+
+
+def test_single_masked_upload_is_full_range():
+    """One masked upload alone spans the int32 range (≈ uniform), hiding
+    the ~±1000 quantized values underneath."""
+    root = jax.random.key(7)
+    t = {"w": jnp.zeros((4096,), jnp.int32)}
+    m = mask_tree(_pair_key(root, jnp.int32(1), jnp.int32(3), 0), t)
+    masked = jax.tree.map(jnp.add, t, m)["w"]
+    # Uniform int32 std = 2^32 / sqrt(12) ≈ 1.24e9.
+    assert float(jnp.abs(masked.astype(jnp.float32)).max()) > 1e9
+    assert abs(float(masked.astype(jnp.float64).std()) - 2**32 / 12**0.5) \
+        / (2**32 / 12**0.5) < 0.05
+
+
+def test_quantize_roundtrip_error_bound():
+    x = {"w": jnp.linspace(-5.0, 5.0, 1001)}
+    scale = 5.0 / 2**19
+    err = np.abs(np.asarray(dequantize_tree(quantize_tree(x, scale),
+                                            scale)["w"] - x["w"]))
+    assert err.max() <= scale / 2 + 1e-9
+
+
+def test_secure_round_matches_clipped_round(fl_setup):
+    """One secure round == one plain clipped (zero-noise DP) round up to
+    the per-coordinate fixed-point bound clip·2^-(bits-1)/2 · (per-client
+    average)."""
+    params, data, xt, yt, cfg = fl_setup
+    sec = SecureAggFedAvgServer(params, _apply(), data, xt, yt, cfg,
+                                clip_norm=5.0, bits=20)
+    plain = DPFedAvgServer(params, _apply(), data, xt, yt, cfg,
+                           clip_norm=5.0, noise_multiplier=0.0)
+    p_sec = sec._round(sec.params, 0)
+    p_plain = plain._round(plain.params, 0)
+    grid = 5.0 / 2**19
+    for a, b in zip(jax.tree.leaves(p_sec), jax.tree.leaves(p_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=grid)  # quantization only
+
+
+def test_secure_agg_learns(fl_setup):
+    params, data, xt, yt, cfg = fl_setup
+    server = SecureAggFedAvgServer(params, _apply(), data, xt, yt, cfg,
+                                   clip_norm=5.0, bits=20)
+    res = server.run(nr_rounds=5)
+    assert res.test_accuracy[-1] > 0.25
+
+
+def _apply():
+    from ddl25spring_tpu.models import mnist_cnn
+    return mnist_cnn.apply
