@@ -1,0 +1,45 @@
+"""FedProx: FedAvg with a proximal local objective (Li et al., MLSys 2020).
+
+Parity-plus (absent in the reference): the standard fix for client drift
+under statistical heterogeneity — each client minimizes
+``F_k(w) + (μ/2)·‖w − w_t‖²`` locally, so divergent non-IID updates are
+tethered to the global model. Same weight-upload round and sample-count-
+weighted averaging as fl.servers.FedAvgServer; only the local solver
+changes (fl.local.local_prox_sgd). ``mu=0`` reproduces FedAvg exactly
+(asserted in tests/test_fedprox.py). To compose FedProx with the
+attack/defense machinery, plug ``local_prox_sgd`` into the Δ-upload
+substrate (fl.servers.FedAvgGradServer) instead — that server, not this
+one, is what attacks and defenses hook into.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..utils import pytree as pt
+from .local import local_prox_sgd
+from .servers import _ServerBase, _weights_for
+
+
+class FedProxServer(_ServerBase):
+    """FedAvg round shape with the proximal local solver; ``mu`` is the
+    proximal coefficient (0 ⇒ exactly FedAvg)."""
+
+    def __init__(self, *args, mu: float = 0.01, **kw):
+        super().__init__(*args, algorithm="fedprox", **kw)
+        self.mu = float(mu)
+        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
+        mu_ = self.mu
+
+        @jax.jit
+        def round_step(params, idx, keys):
+            xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
+            new_weights = jax.vmap(
+                lambda x, y, m, k: local_prox_sgd(
+                    apply_fn, params, x, y, m, epochs=cfg.epochs,
+                    batch_size=cfg.batch_size, lr=cfg.lr, mu=mu_, key=k)
+            )(xs, ys, ms, keys)
+            w = _weights_for(data.sample_counts[idx])
+            return pt.tree_weighted_sum(new_weights, w)
+
+        self._round_step = round_step

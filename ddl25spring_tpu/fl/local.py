@@ -62,25 +62,34 @@ def _batched(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, batch_size: int)
             mask.reshape(n_batches, batch_size))
 
 
-def local_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray, y: jnp.ndarray,
-              mask: jnp.ndarray, *, epochs: int, batch_size: int, lr: float,
-              key=None) -> PyTree:
-    """E epochs of plain SGD over fixed-order minibatches — WeightClient's
-    local loop (train_epoch, hfl_complete.py:71-80; model.train() ⇒ dropout
-    live per batch when a key is threaded). Pure: returns the new params;
-    scan over (epochs × batches) keeps one compiled body. Each (epoch, batch)
-    step folds its own dropout key from the client key."""
+def local_prox_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray,
+                   y: jnp.ndarray, mask: jnp.ndarray, *, epochs: int,
+                   batch_size: int, lr: float, mu: float,
+                   key=None) -> PyTree:
+    """FedProx local solver: E epochs of fixed-order minibatch SGD with the
+    proximal term (μ/2)·‖w − w_global‖² added to every minibatch objective
+    (Li et al., "Federated Optimization in Heterogeneous Networks"). The
+    proximal gradient μ·(w − w_global) tethers heterogeneous clients to
+    the global model, bounding client drift under non-IID data / variable
+    local work. ``mu=0`` drops the term EXACTLY (μ·(w−w₀) multiplies out;
+    pinned in tests/test_fedprox.py) — which is why ``local_sgd`` is this
+    function at μ=0 rather than a second copy of the scan machinery."""
+    w_global = params
     xb, yb, mb = _batched(x, y, mask, batch_size)
-    n_batches = yb.shape[0]
 
     def batch_step(carry, batch):
         p, step_idx = carry
         bx, by, bm = batch
         bkey = None if key is None else jax.random.fold_in(key, step_idx)
-        grads = jax.grad(partial(masked_mean_loss, apply_fn))(p, bx, by, bm, bkey)
+        grads = jax.grad(partial(masked_mean_loss, apply_fn))(p, bx, by, bm,
+                                                              bkey)
         # Empty (all-padding) batches contribute zero gradient.
         nonempty = (bm.sum() > 0).astype(jnp.float32)
-        p = jax.tree.map(lambda w, g: w - lr * nonempty * g, p, grads)
+        # loss + (mu/2)||p - w_global||^2 ⇒ grad += mu*(p - w_global); the
+        # term is added explicitly (cheaper than differentiating it).
+        p = jax.tree.map(
+            lambda w, g, w0: w - lr * nonempty * (g + mu * (w - w0)),
+            p, grads, w_global)
         return (p, step_idx + 1), None
 
     def epoch_step(carry, _):
@@ -90,3 +99,16 @@ def local_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray, y: jnp.ndarray,
     (params, _), _ = lax.scan(epoch_step, (params, jnp.zeros((), jnp.int32)),
                               None, length=epochs)
     return params
+
+
+def local_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray, y: jnp.ndarray,
+              mask: jnp.ndarray, *, epochs: int, batch_size: int, lr: float,
+              key=None) -> PyTree:
+    """E epochs of plain SGD over fixed-order minibatches — WeightClient's
+    local loop (train_epoch, hfl_complete.py:71-80; model.train() ⇒ dropout
+    live per batch when a key is threaded). Pure: returns the new params;
+    scan over (epochs × batches) keeps one compiled body. Each (epoch, batch)
+    step folds its own dropout key from the client key. Implemented as the
+    μ=0 case of ``local_prox_sgd`` (exact — the proximal gradient vanishes)."""
+    return local_prox_sgd(apply_fn, params, x, y, mask, epochs=epochs,
+                          batch_size=batch_size, lr=lr, mu=0.0, key=key)
