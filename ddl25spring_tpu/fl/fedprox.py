@@ -14,32 +14,21 @@ one, is what attacks and defenses hook into.
 
 from __future__ import annotations
 
-import jax
-
-from ..utils import pytree as pt
 from .local import local_prox_sgd
-from .servers import _ServerBase, _weights_for
+from .servers import FedAvgServer
 
 
-class FedProxServer(_ServerBase):
-    """FedAvg round shape with the proximal local solver; ``mu`` is the
+class FedProxServer(FedAvgServer):
+    """FedAvgServer's round shape (sample → vmapped local solve → weighted
+    average) with the proximal local solver swapped in; ``mu`` is the
     proximal coefficient (0 ⇒ exactly FedAvg)."""
 
     def __init__(self, *args, mu: float = 0.01, **kw):
+        self.mu = float(mu)  # before super(): _local_solver reads it
         super().__init__(*args, algorithm="fedprox", **kw)
-        self.mu = float(mu)
-        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
-        mu_ = self.mu
 
-        @jax.jit
-        def round_step(params, idx, keys):
-            xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
-            new_weights = jax.vmap(
-                lambda x, y, m, k: local_prox_sgd(
-                    apply_fn, params, x, y, m, epochs=cfg.epochs,
-                    batch_size=cfg.batch_size, lr=cfg.lr, mu=mu_, key=k)
-            )(xs, ys, ms, keys)
-            w = _weights_for(data.sample_counts[idx])
-            return pt.tree_weighted_sum(new_weights, w)
-
-        self._round_step = round_step
+    def _local_solver(self):
+        cfg, apply_fn, mu = self.cfg, self.apply_fn, self.mu
+        return lambda p, x, y, m, k: local_prox_sgd(
+            apply_fn, p, x, y, m, epochs=cfg.epochs,
+            batch_size=cfg.batch_size, lr=cfg.lr, mu=mu, key=k)

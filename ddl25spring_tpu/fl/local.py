@@ -86,10 +86,19 @@ def local_prox_sgd(apply_fn: ApplyFn, params: PyTree, x: jnp.ndarray,
         # Empty (all-padding) batches contribute zero gradient.
         nonempty = (bm.sum() > 0).astype(jnp.float32)
         # loss + (mu/2)||p - w_global||^2 ⇒ grad += mu*(p - w_global); the
-        # term is added explicitly (cheaper than differentiating it).
-        p = jax.tree.map(
-            lambda w, g, w0: w - lr * nonempty * (g + mu * (w - w0)),
-            p, grads, w_global)
+        # term is added explicitly (cheaper than differentiating it). mu is
+        # a static Python float, so the mu=0 branch is resolved at trace
+        # time: the plain-SGD path (every non-prox server) carries no
+        # proximal arithmetic and no live w_global operand — and the
+        # "drops the term EXACTLY" guarantee is structural, not a
+        # floating-point identity (0.0*(w-w0) could still flip signed
+        # zeros).
+        if mu == 0.0:
+            p = jax.tree.map(lambda w, g: w - lr * nonempty * g, p, grads)
+        else:
+            p = jax.tree.map(
+                lambda w, g, w0: w - lr * nonempty * (g + mu * (w - w0)),
+                p, grads, w_global)
         return (p, step_idx + 1), None
 
     def epoch_step(carry, _):

@@ -159,23 +159,34 @@ class FedSgdWeightServer(_ServerBase):
 
 class FedAvgServer(_ServerBase):
     """E local SGD epochs per sampled client, weight upload, sample-count
-    weighted average (hfl_complete.py:332-386)."""
+    weighted average (hfl_complete.py:332-386).
 
-    def __init__(self, *args, **kw):
-        super().__init__(*args, algorithm="fedavg", **kw)
-        data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
+    The round shape (sample → vmapped local solve → weighted average) is
+    shared by subclasses that swap only the local solver (fl.fedprox):
+    override ``_local_solver`` to return
+    ``solver(params, x, y, mask, key) -> new_params``.
+    """
+
+    def __init__(self, *args, algorithm: str = "fedavg", **kw):
+        super().__init__(*args, algorithm=algorithm, **kw)
+        data = self.data
+        solver = self._local_solver()
 
         @jax.jit
         def round_step(params, idx, keys):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
             new_weights = jax.vmap(
-                lambda x, y, m, k: local_sgd(apply_fn, params, x, y, m,
-                                             epochs=cfg.epochs, batch_size=cfg.batch_size,
-                                             lr=cfg.lr, key=k))(xs, ys, ms, keys)
+                lambda x, y, m, k: solver(params, x, y, m, k))(xs, ys, ms, keys)
             w = _weights_for(data.sample_counts[idx])
             return pt.tree_weighted_sum(new_weights, w)
 
         self._round_step = round_step
+
+    def _local_solver(self):
+        cfg, apply_fn = self.cfg, self.apply_fn
+        return lambda p, x, y, m, k: local_sgd(
+            apply_fn, p, x, y, m, epochs=cfg.epochs,
+            batch_size=cfg.batch_size, lr=cfg.lr, key=k)
 
 
 class FedAvgGradServer(_ServerBase):
