@@ -98,6 +98,12 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
         sys.exit(3)
     overrides = _json.loads(overrides_json)
     opt_name = overrides.pop("_opt", "fused")  # reserved key, not a cfg field
+    if opt_name == "pallas":
+        # Gate the '+padam' number on a real-lowering smoke: interpret-mode
+        # CPU tests validate the math, not the Mosaic compile. A broken
+        # lowering fails THIS child, not the whole bench.
+        from ddl25spring_tpu.ops.pallas_adam import smoke_check
+        smoke_check()
     cfg = dataclasses.replace(LlamaConfig(dtype="bfloat16"), **overrides)
     n_dev = len(jax.devices())
     mesh = make_mesh({"data": n_dev})

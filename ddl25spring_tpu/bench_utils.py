@@ -37,6 +37,10 @@ def make_optimizer(opt_name: str, lr: float = 8e-4):
     if opt_name == "master":
         from .ops.mixed_precision import master_weight_adam
         return master_weight_adam(lr)
+    if opt_name != "fused":
+        raise ValueError(
+            f"unknown optimizer {opt_name!r}: expected one of "
+            "'fused', 'pallas', 'master'")
     return fused_adam(lr)
 
 

@@ -70,8 +70,11 @@ def mask_tree(key, tree):
     draws; addition wraps mod 2^32)."""
     leaves, treedef = jax.tree.flatten(tree)
     keys = jax.random.split(key, len(leaves))
-    masks = [jax.random.randint(k, l.shape, jnp.iinfo(jnp.int32).min,
-                                jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    # 32 raw PRG bits per element, bitcast to int32: exactly uniform over
+    # the mod-2^32 ring (randint's exclusive maxval would never emit
+    # 2^31-1, leaving one ring element with probability 0).
+    masks = [jax.lax.bitcast_convert_type(
+                 jax.random.bits(k, l.shape, dtype=jnp.uint32), jnp.int32)
              for k, l in zip(keys, leaves)]
     return jax.tree.unflatten(treedef, masks)
 

@@ -99,6 +99,41 @@ def _leaf_jnp(p, m, v, g, c1, c2, *, lr, b1, b2, eps):
     return p + u, m, v
 
 
+def smoke_check(atol: float = 1e-5) -> None:
+    """One-step Mosaic-lowering smoke: run the compiled kernel (interpret
+    only if off-TPU) on one eligible leaf and assert it matches the jnp
+    rule. The bench gates the '+padam' variant on this so a kernel whose
+    actual TPU lowering is wrong can never produce a trusted number —
+    interpret-mode CPU tests exercise the math, not the lowering.
+    Raises on mismatch; returns None when the kernel is trustworthy."""
+    key = jax.random.key(0)
+    kp, km, kv, kg = jax.random.split(key, 4)
+    # 972 rows of 512 lanes: >_ROW_BLOCK rows forces a multi-step grid with
+    # a ragged last block — the configuration the real 24 M-param leaves
+    # hit (e.g. the 6×288×288 stack is rows=972) — so the gate exercises
+    # index_map stepping, cross-step scalar prefetch, and multi-block
+    # aliasing, not just a single-block lowering.
+    shape = (972 * _LANES,)
+    p = jax.random.normal(kp, shape, jnp.float32)
+    m = 0.1 * jax.random.normal(km, shape, jnp.float32)
+    v = jnp.abs(0.1 * jax.random.normal(kv, shape, jnp.float32))
+    g = jax.random.normal(kg, shape, jnp.float32)
+    hyper = dict(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8)
+    c1, c2 = 1.0 - 0.9 ** 3, 1.0 - 0.999 ** 3
+    corrections = jnp.asarray([c1, c2], jnp.float32)
+    interpret = jax.default_backend() != "tpu"
+    got = _adam_leaf_pallas(p, m, v, g, corrections, interpret=interpret,
+                            **hyper)
+    want = _leaf_jnp(p, m, v, g, c1, c2, **hyper)
+    for name, a, b in zip(("p", "m", "v"), got, want):
+        err = float(jnp.max(jnp.abs(a - b)))
+        if not err <= atol:      # NaN-safe: NaN fails the comparison
+            raise AssertionError(
+                f"pallas Adam smoke: {name} max|Δ|={err:.3e} > {atol} on "
+                f"backend {jax.default_backend()!r} — kernel lowering is "
+                "not trustworthy")
+
+
 def _pallas_eligible(p, g) -> bool:
     return (p.dtype == jnp.float32 and g.dtype == jnp.float32
             and p.size >= _MIN_PALLAS and p.size % _LANES == 0)
