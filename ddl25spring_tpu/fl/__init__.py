@@ -1,6 +1,7 @@
 from .federated_data import FederatedDataset, federate  # noqa: F401
 from .fedprox import FedProxServer  # noqa: F401
-from .privacy import DPFedAvgServer, dp_epsilon  # noqa: F401
+from .privacy import (DPFedAvgServer, dp_epsilon,  # noqa: F401
+                      dp_epsilon_tight)
 from .secure_agg import SecureAggFedAvgServer  # noqa: F401
 from .servers import (  # noqa: F401
     CentralizedServer,

@@ -17,12 +17,19 @@ TPU-first shape: clipping is a vmapped pure function over the stacked
 client axis; the noise is one fused normal-sample + add over the param
 tree; everything stays inside the server's single jitted round_step.
 
-Privacy accounting (``dp_epsilon``) uses the CONSERVATIVE advanced-
-composition bound for T Gaussian mechanisms with noise multiplier z:
-    ε(δ) = sqrt(2·T·ln(1/δ))/z + T/(2z²)
-It deliberately ignores privacy amplification by client subsampling, so
-the reported ε is an overestimate (safe direction). A tight subsampled-RDP
-accountant is out of scope; the docstring says what the number is.
+Privacy accounting — two bounds, both self-contained:
+- ``dp_epsilon``: the CONSERVATIVE advanced-composition bound for T
+  Gaussian mechanisms with noise multiplier z,
+      ε(δ) = sqrt(2·T·ln(1/δ))/z + T/(2z²),
+  ignoring privacy amplification by client subsampling (overestimate,
+  safe direction).
+- ``dp_epsilon_tight``: the subsampled-Gaussian RDP (moments) accountant
+  — Mironov et al., "Rényi Differential Privacy of the Sampled Gaussian
+  Mechanism" (2019), integer orders — with amplification by the per-round
+  client sampling rate q = C (Poisson-style sampling assumption). For the
+  reference protocol (C=0.1) this is typically an order of magnitude
+  below the conservative bound; pinned against Abadi et al. (2016)'s
+  published moments-accountant value in tests/test_privacy_accounting.py.
 """
 
 from __future__ import annotations
@@ -65,6 +72,78 @@ def dp_epsilon(noise_multiplier: float, rounds: int,
     if z <= 0:
         return float("inf")
     return math.sqrt(2.0 * t * math.log(1.0 / delta)) / z + t / (2.0 * z * z)
+
+
+# ---------------------------------------------------------------------------
+# Subsampled-Gaussian RDP (moments) accountant — self-contained, no deps.
+
+# Integer Rényi orders: dense where the minimum usually lands, sparse tail
+# for very-high-privacy regimes.
+_RDP_ORDERS = tuple(range(2, 65)) + (80, 96, 128, 192, 256, 384, 512)
+
+
+def _log_binom(n: int, k: int) -> float:
+    return (math.lgamma(n + 1) - math.lgamma(k + 1)
+            - math.lgamma(n - k + 1))
+
+
+def _rdp_sgm(q: float, z: float, alpha: int) -> float:
+    """One-step RDP of order ``alpha`` (integer ≥ 2) of the Gaussian
+    mechanism with noise multiplier ``z``, amplified by Poisson subsampling
+    at rate ``q`` — Mironov et al. 2019, Eq. for integer orders:
+
+        RDP(α) = 1/(α−1) · log Σ_{k=0}^{α} C(α,k)·(1−q)^{α−k}·q^k
+                                   · exp(k(k−1)/(2z²))
+    """
+    if q == 0.0:
+        return 0.0
+    if q >= 1.0:                      # no subsampling: plain Gaussian RDP
+        return alpha / (2.0 * z * z)
+    # log-domain sum over k (log-sum-exp) — the k=α term alone can overflow
+    # a float for large α/small z.
+    log_terms = [
+        _log_binom(alpha, k) + (alpha - k) * math.log1p(-q)
+        + (k * math.log(q) if k else 0.0)
+        + k * (k - 1) / (2.0 * z * z)
+        for k in range(alpha + 1)
+    ]
+    hi = max(log_terms)
+    lse = hi + math.log(sum(math.exp(t - hi) for t in log_terms))
+    return lse / (alpha - 1)
+
+
+def dp_epsilon_tight(noise_multiplier: float, rounds: int,
+                     sampling_rate: float, delta: float = 1e-5) -> float:
+    """Tight ε via the subsampled-Gaussian RDP accountant.
+
+    ``sampling_rate`` is the per-round probability that a given client is
+    sampled — the FL protocol's client fraction C (the accountant assumes
+    Poisson sampling; the protocol's fixed-size sampling is the standard
+    approximation). RDP composes additively over ``rounds``; the conversion
+    to (ε, δ) uses the improved bound of Canonne-Kamath-Steinke 2020:
+
+        ε = RDP_T(α) + log((α−1)/α) − (log δ + log α)/(α−1)
+
+    minimized over the integer order grid. Returns +inf for z ≤ 0.
+
+    Regime note: the subsampled bound is the tight one at protocol-scale
+    noise (z ≳ 0.5 — e.g. an 8×+ improvement at C=0.1, T=100, z=1); at
+    very small z the exp(k(k−1)/2z²) moment term blows past advanced
+    composition instead. Both are valid upper bounds — a privacy
+    certificate may always quote min(this, dp_epsilon(...)).
+    """
+    z, t, q = float(noise_multiplier), int(rounds), float(sampling_rate)
+    if z <= 0:
+        return float("inf")
+    if q <= 0.0 or t == 0:
+        return 0.0
+    best = float("inf")
+    for alpha in _RDP_ORDERS:
+        rdp = t * _rdp_sgm(q, z, alpha)
+        eps = (rdp + math.log((alpha - 1) / alpha)
+               - (math.log(delta) + math.log(alpha)) / (alpha - 1))
+        best = min(best, eps)
+    return max(0.0, best)
 
 
 class DPFedAvgServer(_ServerBase):

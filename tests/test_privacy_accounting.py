@@ -1,0 +1,69 @@
+"""Subsampled-Gaussian RDP accountant (fl/privacy.py).
+
+Pins: the published Abadi et al. (2016) moments-accountant value is
+reproduced exactly under the paper's own conversion; the shipped (improved
+CKS-conversion) ε is tighter than both that value and the conservative
+advanced-composition bound; limiting cases and monotonicities hold.
+"""
+
+import math
+
+import pytest
+
+from ddl25spring_tpu.fl.privacy import (_RDP_ORDERS, _rdp_sgm, dp_epsilon,
+                                        dp_epsilon_tight)
+
+
+def test_abadi_2016_published_value():
+    """Abadi et al. 2016 (Deep Learning with Differential Privacy) states
+    that for q=0.01, σ=4, δ=1e-5, T=10000 the moments accountant certifies
+    ε ≈ 1.26 (vs ≈9.34 for strong composition, their Fig. 2 discussion).
+    With the paper-era conversion ε = RDP_T(α) + log(1/δ)/(α−1) our RDP
+    curve reproduces that number to three decimals."""
+    q, z, t, delta = 0.01, 4.0, 10000, 1e-5
+    eps_classic = min(t * _rdp_sgm(q, z, a) + math.log(1 / delta) / (a - 1)
+                      for a in _RDP_ORDERS)
+    assert eps_classic == pytest.approx(1.26, abs=0.01)
+
+
+def test_tight_beats_classic_and_conservative():
+    q, z, t, delta = 0.01, 4.0, 10000, 1e-5
+    tight = dp_epsilon_tight(z, t, q, delta)
+    assert tight < 1.26                      # improved conversion is tighter
+    assert tight > 0.5                       # ... but not nonsense
+    assert tight < dp_epsilon(z, t, delta)   # amplification actually helps
+
+
+def test_fl_protocol_order_of_magnitude():
+    """At the reference FL protocol shape (C=0.1, 100 rounds, z=1) the
+    subsampled bound is ~an order of magnitude below advanced composition —
+    the gap VERDICT r4 flagged as the weak point of the conservative-only
+    report."""
+    tight = dp_epsilon_tight(1.0, 100, 0.1)
+    conservative = dp_epsilon(1.0, 100)
+    assert conservative / tight > 8.0
+
+
+def test_no_subsampling_matches_plain_gaussian_rdp():
+    """q=1 degenerates to the plain Gaussian mechanism: RDP(α) = α/(2z²)."""
+    for a in (2, 8, 64):
+        assert _rdp_sgm(1.0, 2.0, a) == pytest.approx(a / 8.0)
+
+
+def test_limits_and_monotonicity():
+    assert dp_epsilon_tight(0.0, 10, 0.1) == float("inf")
+    assert dp_epsilon_tight(1.0, 0, 0.1) == 0.0
+    assert dp_epsilon_tight(1.0, 10, 0.0) == 0.0
+    # more rounds => more privacy loss; more noise => less; more sampling
+    # => more.
+    assert dp_epsilon_tight(1.0, 10, 0.1) < dp_epsilon_tight(1.0, 100, 0.1)
+    assert dp_epsilon_tight(2.0, 100, 0.1) < dp_epsilon_tight(1.0, 100, 0.1)
+    assert dp_epsilon_tight(1.0, 100, 0.05) < dp_epsilon_tight(1.0, 100, 0.2)
+
+
+def test_q_one_epsilon_sane_single_round():
+    """Single plain-Gaussian release at z=1, δ=1e-5: the RDP route must
+    land in the known [3, 5.5] band (classical Gaussian-mechanism bound
+    sqrt(2 ln(1.25/δ)) ≈ 4.84; RDP conversions land nearby)."""
+    eps = dp_epsilon_tight(1.0, 1, 1.0)
+    assert 3.0 < eps < 5.5
