@@ -11,11 +11,32 @@ Capability targets:
   Σ per-client MSE + KL/batch (lab/hw02/Tea_Pula_HW2.ipynb cells 32-41,
   total ≈4.1 at 1000 epochs).
 
-Documented deviation: the reference calls ``optimizer.zero_grad()`` once per
-EPOCH (vfl.py:62), so each minibatch step applies the running sum of all
-previous minibatch gradients of that epoch — an accumulation quirk, not a
-design choice. Here each step uses its own minibatch gradient (the intended
-semantics); convergence matches the reference's reported accuracy band.
+Two trainer modes:
+- default (``faithful=False``): the intended semantics — every parameter
+  trains on each minibatch's own gradient, dropout disabled at test time.
+  On the reference's duplicate-leaking heart.csv split this trains to
+  98-100%.
+- ``faithful=True``: reproduces the four reference protocol quirks its
+  published 84.8-85.3% band was measured through:
+  (1) **frozen bottom models** — ``VFLNetwork`` keeps its bottoms in a
+  plain Python list, not an ``nn.ModuleList`` (vfl.py:48), so
+  ``optim.AdamW(self.parameters())`` (vfl.py:50) never sees their
+  parameters: gradients flow to the clients' models but they are NEVER
+  stepped; the entire run trains only the server's top model on frozen
+  random client features. This is the dominant quirk — it alone caps the
+  system near the published band (measured: torch-side parameter count
+  41,346 seen by the optimizer vs 1,596 bottom params excluded, and
+  bottom weights bit-identical after training);
+  (2) ``optim.AdamW`` — decoupled weight decay at torch's defaults
+  lr=1e-3, wd=1e-2;
+  (3) ``zero_grad()`` once per EPOCH (vfl.py:62), so the step at
+  minibatch k applies the running SUM of minibatch gradients 1..k;
+  (4) ``test()`` uses ``torch.no_grad()`` but never ``.eval()``
+  (vfl.py:91-102) — and ``.eval()`` could not reach the list-held
+  bottoms anyway — so evaluation runs with dropout STILL ACTIVE,
+  including the Dropout(0.1) on the output logits (vfl.py:40): the
+  reported accuracy is one stochastic dropout draw.
+  The per-quirk attribution is measured in experiments/hw2_vfl.py.
 
 TPU-native shape: one jitted `lax.scan` over padded minibatches per epoch —
 party feature widths differ, so per-party arrays ride the scan as a tuple;
@@ -42,30 +63,58 @@ from .batching import pad_batches
 class VFLReport:
     train_losses: List[float] = field(default_factory=list)   # per epoch
     train_accuracies: List[float] = field(default_factory=list)
-    test_accuracy: float = 0.0
+    test_accuracy: float = 0.0        # under the trainer's own eval protocol
+    test_accuracy_clean: float = 0.0  # always dropout-off (intended eval)
 
 
 def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
               xs_test: Sequence[np.ndarray], y_test: np.ndarray,
               cfg: Optional[VFLConfig] = None, *,
+              faithful: bool = False,
+              train_bottoms: Optional[bool] = None,
+              accumulate_epoch_grads: Optional[bool] = None,
+              eval_dropout: Optional[bool] = None,
+              weight_decay: Optional[float] = None,
               log_every: int = 0,
               log_fn: Callable[[str], None] = print) -> Tuple[dict, VFLReport]:
     """Jointly train bottoms+top over vertically-partitioned features.
 
     ``xs_train[i]`` is party i's feature slice [N, d_i]. Returns the trained
     params and per-epoch train metrics + final test accuracy.
+
+    ``faithful=True`` enables all four reference protocol quirks (module
+    docstring); the keyword overrides toggle each quirk independently for
+    attribution (None ⇒ follow ``faithful``):
+    ``train_bottoms=False`` — the dominant quirk: bottom models receive
+    gradients but are never stepped (the reference's plain-list /
+    ``self.parameters()`` bug), so only the top model learns;
+    ``weight_decay`` — AdamW decoupled decay (reference default 1e-2);
+    ``accumulate_epoch_grads`` — zero-grad once per epoch, each step applies
+    the epoch's running gradient sum; ``eval_dropout`` — evaluate with
+    dropout active (one stochastic draw), the reference's missing-.eval()
+    protocol. ``report.test_accuracy`` follows the eval protocol chosen;
+    ``report.test_accuracy_clean`` is always the dropout-off number.
     """
     cfg = cfg or VFLConfig()
+    bottoms_train = ((not faithful) if train_bottoms is None
+                     else train_bottoms)
+    accumulate = (faithful if accumulate_epoch_grads is None
+                  else accumulate_epoch_grads)
+    drop_eval = faithful if eval_dropout is None else eval_dropout
+    wd = (1e-2 if faithful else 0.0) if weight_decay is None else weight_decay
+
     feature_dims = [int(a.shape[1]) for a in xs_train]
     params = vfl_nets.init_vfl(jax.random.key(cfg.seed), feature_dims,
                                bottom_out_mult=cfg.bottom_out_mult)
-    optimizer = optax.adam(cfg.lr)
+    optimizer = (optax.adamw(cfg.lr, weight_decay=wd) if wd
+                 else optax.adam(cfg.lr))
     opt_state = optimizer.init(params)
 
     xs_b, y_b, m_b = pad_batches(xs_train, y_train, cfg.batch_size)
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
 
     def minibatch_step(carry, batch):
-        params, opt_state = carry
+        params, opt_state, accum = carry
         xs, y, m, key = batch
 
         def loss_fn(p):
@@ -73,23 +122,40 @@ def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
             return cross_entropy_loss(logits, y, m), logits
 
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if accumulate:
+            # Reference quirk (vfl.py:62): .grad is never zeroed within an
+            # epoch, so step k sees the SUM of minibatch grads 1..k.
+            grads = accum = jax.tree.map(jnp.add, accum, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if not bottoms_train:
+            # Dominant reference quirk (vfl.py:48-50): the bottoms live in
+            # a plain list outside self.parameters(), so the optimizer
+            # never steps them — zero their UPDATES (not their grads: in
+            # torch they are absent from the optimizer entirely, so no
+            # AdamW decay reaches them either).
+            updates = {"top": updates["top"],
+                       "bottoms": jax.tree.map(jnp.zeros_like,
+                                               updates["bottoms"])}
         params = optax.apply_updates(params, updates)
         correct = ((logits.argmax(-1) == y) * m).sum()
-        return (params, opt_state), (loss * m.sum(), correct, m.sum())
+        return (params, opt_state, accum), (loss * m.sum(), correct, m.sum())
 
     @jax.jit
     def epoch_fn(params, opt_state, epoch_key):
         keys = jax.random.split(epoch_key, y_b.shape[0])
-        (params, opt_state), (losses, correct, counts) = jax.lax.scan(
-            minibatch_step, (params, opt_state), (xs_b, y_b, m_b, keys))
+        (params, opt_state, _), (losses, correct, counts) = jax.lax.scan(
+            minibatch_step, (params, opt_state, zero_grads),
+            (xs_b, y_b, m_b, keys))
         n = counts.sum()
         return params, opt_state, losses.sum() / n, correct.sum() / n
 
+    xs_te = tuple(jnp.asarray(a) for a in xs_test)
+    y_te = jnp.asarray(y_test)
+
     @jax.jit
-    def test_acc(params):
-        logits = vfl_nets.vfl_forward(params, tuple(jnp.asarray(a) for a in xs_test))
-        return (logits.argmax(-1) == jnp.asarray(y_test)).mean()
+    def test_acc(params, key=None):
+        logits = vfl_nets.vfl_forward(params, xs_te, key=key)
+        return (logits.argmax(-1) == y_te).mean()
 
     report = VFLReport()
     dropout_key = jax.random.key(cfg.seed + 1)
@@ -101,7 +167,14 @@ def train_vfl(xs_train: Sequence[np.ndarray], y_train: np.ndarray,
         if log_every and epoch % log_every == 0:
             log_fn(f"epoch {epoch}: loss {report.train_losses[-1]:.4f} "
                    f"acc {report.train_accuracies[-1]:.4f}")
-    report.test_accuracy = float(test_acc(params))
+    report.test_accuracy_clean = float(test_acc(params))
+    if drop_eval:
+        # One stochastic dropout draw — exactly what the reference reports
+        # (test() under no_grad but the module still in training mode).
+        report.test_accuracy = float(
+            test_acc(params, jax.random.key(cfg.seed + 2)))
+    else:
+        report.test_accuracy = report.test_accuracy_clean
     return params, report
 
 

@@ -79,6 +79,9 @@ def main(quick: bool = False) -> Dict[str, float]:
             print(f"vfl {partitioner:4s} {n:2d} clients: "
                   f"test acc {rep.test_accuracy:.4f}")
 
+    # --- faithful-protocol battery + per-quirk attribution --------------
+    finals.update(_faithful_rows(sink, provenance, epochs))
+
     # --- VFL-VAE (cell 40) ----------------------------------------------
     sink_v = common.sink("hw2_vfl_vae.csv")
     vae_epochs = 50 if quick else 1000
@@ -98,7 +101,77 @@ def main(quick: bool = False) -> Dict[str, float]:
     return finals
 
 
+def _faithful_rows(sink, provenance: str, epochs: int) -> Dict[str, float]:
+    """The faithful + per-quirk battery — one implementation shared by the
+    full run (main) and the in-place refresh (faithful_only).
+
+    The reference's published 84.8-85.3% band was measured through four
+    protocol quirks (train/vfl.py module docstring), dominated by the
+    frozen-bottoms bug: VFLNetwork holds its bottoms in a plain Python
+    list, so optim.AdamW(self.parameters()) never steps them — only the
+    top model learns, on frozen random client features (vfl.py:48-50).
+    `faithful` rows run the 3-permutation battery under all four quirks;
+    the `quirk_*` rows toggle one at a time at seed 0.
+    """
+    finals: Dict[str, float] = {}
+
+    def one(experiment: str, final_key: str, label: str, seed: int, **kw):
+        xs_tr, y_tr, xs_te, y_te, _ = common.heart_vfl_setup(
+            4, "even", seed=seed)
+        cfg = VFLConfig(nr_clients=4, epochs=epochs, seed=seed)
+        _, rep = train_vfl(xs_tr, y_tr, xs_te, y_te, cfg, **kw)
+        finals[final_key] = rep.test_accuracy
+        sink.write({"experiment": experiment, "partitioner": "even",
+                    "nr_clients": 4, "seed": seed, "epochs": epochs,
+                    "final_train_acc": rep.train_accuracies[-1],
+                    "test_accuracy": rep.test_accuracy,
+                    "test_accuracy_clean": rep.test_accuracy_clean,
+                    "data": provenance})
+        print(f"vfl 4 clients {label}: test acc {rep.test_accuracy:.4f} "
+              f"(clean {rep.test_accuracy_clean:.4f})", flush=True)
+
+    for seed in (0, 1, 2):
+        one("vfl_4client_faithful", f"vfl4-faithful/perm{seed}",
+            f"perm {seed} FAITHFUL", seed, faithful=True)
+    quirks = {"frozen": dict(train_bottoms=False),
+              "wd": dict(weight_decay=1e-2),
+              "accum": dict(accumulate_epoch_grads=True),
+              "evaldrop": dict(eval_dropout=True)}
+    for name, kw in quirks.items():
+        one(f"vfl_4client_quirk_{name}", f"vfl4-quirk/{name}",
+            f"quirk={name:8s}", 0, **kw)
+    return finals
+
+
+def faithful_only(epochs: int = 300) -> None:
+    """Rerun ONLY the faithful + quirk rows, replacing them in the committed
+    CSV (the rest of the battery is untouched — identical protocol, no need
+    to re-measure)."""
+    import os
+
+    import pandas as pd
+
+    from ddl25spring_tpu.utils.tracing import ResultSink
+
+    path = os.path.join(common.RESULTS_DIR, "hw2_vfl.csv")
+    df = pd.read_csv(path)
+    keep = ~df["experiment"].str.startswith(("vfl_4client_faithful",
+                                             "vfl_4client_quirk"))
+    df[keep].to_csv(path, index=False)
+    _faithful_rows(ResultSink(path), common.heart_provenance(), epochs)
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    main(quick=ap.parse_args().quick)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--faithful-only", action="store_true",
+                    help="rerun only the faithful/quirk rows in place")
+    a = ap.parse_args()
+    if a.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if a.faithful_only:
+        faithful_only()
+    else:
+        main(quick=a.quick)
