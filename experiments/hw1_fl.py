@@ -87,32 +87,64 @@ def main(quick: bool = False, n_train: int = 60000, n_test: int = 10000
     return finals
 
 
-def matched_shards(n_test: int = 2000, rounds: int = 10) -> Dict:
-    """Append the FedAvg N-sweep at the reference's per-client shard sizes.
+def matched_shards(n_test: int = 2000, rounds: int = 10,
+                   algorithms: Tuple[str, ...] = ("fedavg", "fedsgd"),
+                   c_sweep: bool = True) -> Dict:
+    """Append the N-sweep and C-sweep at the reference's per-client shard
+    sizes (n_train=60,000).
 
     The committed CPU run shrinks the corpus to 12,000 rows, which starves
     high-N FedAvg clients to ~1 local step per round and collapses the
-    N-scaling signature (VERDICT r03 weak #2). Per the measured
-    accuracy-vs-steps curve of the synthetic generator, the signature is a
-    shard-size effect, not a generator effect — so this runs ONLY the three
-    FedAvg C=0.1 rows at the full n_train=60,000 (600–6,000 rows/client,
+    N-scaling signature (VERDICT r03 weak #2), and leaves FedSGD's
+    one-gradient-per-round numbers in the noise (VERDICT r04 weak #4). Per
+    the measured accuracy-vs-steps curve of the synthetic generator, both
+    are shard-size effects, not generator effects — so this reruns the
+    reference tables at the full n_train=60,000 (600–6,000 rows/client,
     exactly the reference's shard sizes) and appends them, labeled by their
-    n_train column, next to the 12k battery.
+    n_train column, next to the 12k battery:
+    - N ∈ {10, 50, 100} at C=0.1, both algorithms (homework-1.ipynb cell
+      27: FedSGD flat ≈43.1–43.2%, FedAvg 93.2/87.9/81.3%);
+    - C ∈ {0.01, 0.2} at N=100, both algorithms (cell 30: FedSGD flat
+      ≈41.9–42.9%, FedAvg C-monotone 73.4/81.3/81.9% — C=0.1 is shared
+      with the N-sweep).
     """
     import os
 
     from ddl25spring_tpu.utils.tracing import ResultSink
 
-    sink = ResultSink(os.path.join(common.RESULTS_DIR, "hw1_fl.csv"))
+    classes = {"fedavg": FedAvgServer, "fedsgd": FedSgdGradientServer}
+    path = os.path.join(common.RESULTS_DIR, "hw1_fl.csv")
+    # Idempotent append: combos already in the CSV with a full-length 60k
+    # curve are skipped, so the battery can resume after a wall-clock kill.
+    have = set()
+    if os.path.exists(path):
+        import pandas as pd
+
+        df = pd.read_csv(path)
+        # A combo counts as done only when its curve actually REACHED the
+        # final round — raw row counts would let two stacked partial runs
+        # mask an unfinished combo forever.
+        last = (df[df["n_train"] == 60000]
+                .groupby(["algorithm", "N", "C"])["round"].max())
+        have = {key for key, r in last.items() if r >= rounds}
+    sink = ResultSink(path)
     provenance = common.mnist_provenance()
     finals = {}
-    for n in (10, 50, 100):
-        cfg = FLConfig(nr_clients=n, client_fraction=0.1, rounds=rounds)
-        acc = run_one(FedAvgServer, cfg, sink, provenance,
-                      n_train=60000, n_test=n_test)
-        finals[("fedavg-60k", n, 0.1)] = acc
-        print(f"fedavg N={n:3d} C=0.10 n_train=60000: final acc {acc:.4f}",
-              flush=True)
+    sweeps = [(n, 0.1) for n in (10, 50, 100)]
+    if c_sweep:
+        sweeps += [(100, c) for c in (0.01, 0.2)]
+    for n, c in sweeps:
+        for name in algorithms:
+            if (name, n, c) in have:
+                print(f"{name} N={n} C={c:.2f} n_train=60000: already in "
+                      "CSV, skipping", flush=True)
+                continue
+            cfg = FLConfig(nr_clients=n, client_fraction=c, rounds=rounds)
+            acc = run_one(classes[name], cfg, sink, provenance,
+                          n_train=60000, n_test=n_test)
+            finals[(f"{name}-60k", n, c)] = acc
+            print(f"{name} N={n:3d} C={c:.2f} n_train=60000: "
+                  f"final acc {acc:.4f}", flush=True)
     return finals
 
 
