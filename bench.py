@@ -125,11 +125,12 @@ def _time_batch_subprocess(overrides: dict, bs: int, timeout: int
 
 
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
-                new_tokens: int = 128, bf16_params: bool = False) -> float:
+                new_tokens: int = 128, bf16_params: bool = False,
+                kv_dtype=None) -> float:
     """Decode tokens/sec — the shared core (bench_utils.time_decode)."""
     from ddl25spring_tpu.bench_utils import time_decode as _td
     return _td(cfg, batch, prompt_len=prompt_len, new_tokens=new_tokens,
-               bf16_params=bf16_params)
+               bf16_params=bf16_params, kv_dtype=kv_dtype)
 
 
 def main():
@@ -242,16 +243,26 @@ def main():
     # bench contract of its one required line. Batch 1 is the latency case,
     # batch 32 the serving case. Greedy, 64-token prompt, 128 new tokens.
     sys.stdout.flush()
-    for dec_bs in ((1,) if PLATFORM in (None, "cpu") else (1, 32)):
-        for bf16p in ((False,) if PLATFORM in (None, "cpu") else (False, True)):
-            label = " bf16-params" if bf16p else ""
-            try:
-                tps = time_decode(base, dec_bs, bf16_params=bf16p)
-                print(f"decode batch {dec_bs:3d}{label}: {tps:12.0f} tok/s",
-                      file=sys.stderr)
-            except Exception as e:  # never let the sidebar look like a failure
-                print(f"decode batch {dec_bs}{label}: failed ({e})",
-                      file=sys.stderr)
+    # Variant grid maps onto the decode roofline's two HBM streams
+    # (ROOFLINE.md): bf16-params halves weight bytes (the batch-1 lever),
+    # bf16-kv halves cache bytes (the batch-32 lever).
+    if PLATFORM in (None, "cpu"):
+        dec_variants = [(1, False, None, "")]
+    else:
+        dec_variants = [(b, p, kv, f"{' bf16-params' if p else ''}"
+                                    f"{' bf16-kv' if kv else ''}")
+                        for b in (1, 32)
+                        for p, kv in ((False, None), (True, None),
+                                      (False, "bfloat16"),
+                                      (True, "bfloat16"))]
+    for dec_bs, bf16p, kv, label in dec_variants:
+        try:
+            tps = time_decode(base, dec_bs, bf16_params=bf16p, kv_dtype=kv)
+            print(f"decode batch {dec_bs:3d}{label}: {tps:12.0f} tok/s",
+                  file=sys.stderr)
+        except Exception as e:  # never let the sidebar look like a failure
+            print(f"decode batch {dec_bs}{label}: failed ({e})",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

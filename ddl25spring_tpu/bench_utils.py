@@ -79,13 +79,14 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
 
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                 new_tokens: int = 128, bf16_params: bool = False,
-                reps: int = 3) -> float:
+                kv_dtype: Optional[str] = None, reps: int = 3) -> float:
     """Generated tokens/sec for the KV-cache decode loop (models/generate).
 
-    ``bf16_params`` stores the weights in bf16 before decoding: the batch-1
-    decode step is matVEC weight-bandwidth-bound, so halving the stored
-    weight bytes is the single biggest serving lever (training keeps fp32
-    master params; casting a copy for inference is the deployment shape)."""
+    The two serving levers, matching the decode roofline's two HBM streams
+    (experiments/ROOFLINE.md): ``bf16_params`` halves the weight bytes —
+    dominant at batch 1 (training keeps fp32 master params; casting a copy
+    for inference is the deployment shape); ``kv_dtype="bfloat16"`` halves
+    the cache bytes — dominant once the batch amortizes the weights."""
     from .models import generate as gen
     params = llama.init_llama(jax.random.key(0), cfg)
     if bf16_params:
@@ -94,10 +95,11 @@ def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
             if a.dtype == jnp.float32 else a, params)
     prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len),
                                 0, cfg.vocab_size)
-    out = gen.generate(params, prompt, cfg, new_tokens)
+    out = gen.generate(params, prompt, cfg, new_tokens, kv_dtype=kv_dtype)
     jax.block_until_ready(out)                      # compile + warm
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = gen.generate(params, prompt, cfg, new_tokens)
+        out = gen.generate(params, prompt, cfg, new_tokens,
+                           kv_dtype=kv_dtype)
     jax.block_until_ready(out)
     return batch * new_tokens * reps / (time.perf_counter() - t0)

@@ -32,10 +32,17 @@ from .. import nn
 from . import llama
 
 
-def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
-    """Zeroed KV cache: {"k","v"} each [L, B, max_len, H, Dh] in the compute
-    dtype. ``max_len`` bounds prompt + generated tokens."""
-    dt = jnp.dtype(cfg.dtype)
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               kv_dtype: Optional[str] = None) -> dict:
+    """Zeroed KV cache: {"k","v"} each [L, B, max_len, H, Dh]. ``max_len``
+    bounds prompt + generated tokens. ``kv_dtype`` overrides the storage
+    dtype (default: the compute dtype): serving decode re-reads the whole
+    cache every step, so bf16 storage halves the per-step KV traffic — the
+    dominant HBM stream once the batch amortizes the weights (see
+    experiments/ROOFLINE.md, decode section). K is stored post-RoPE and
+    attention runs fp32 softmax either way; the only precision change is
+    the rounding of cached K/V."""
+    dt = jnp.dtype(kv_dtype or cfg.dtype)
     shape = (cfg.n_layers, batch, max_len, cfg.num_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -49,8 +56,10 @@ def _attend_cached(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
     tmax = ck.shape[1]
     scale = 1.0 / math.sqrt(dh)
     qm = q.transpose(0, 2, 1, 3).reshape(b * h, tq, dh)
-    km = ck.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh)
-    vm = cv.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh)
+    # Casts after the transpose/reshape fuse into the dots: the HBM read is
+    # of the cache's storage dtype (bf16 when kv_dtype narrows it).
+    km = ck.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh).astype(q.dtype)
+    vm = cv.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh).astype(q.dtype)
     scores = lax.dot_general(qm, km, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32) * scale
     mask = q_positions[:, None] >= jnp.arange(tmax)[None, :]   # [Tq, Tmax]
@@ -168,18 +177,19 @@ def _sample(key, logits: jnp.ndarray, temperature: float,
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
-                                   "top_k", "top_p", "max_len"))
+                                   "top_k", "top_p", "max_len", "kv_dtype"))
 def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
              max_new_tokens: int, *, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
-             max_len: Optional[int] = None) -> jnp.ndarray:
+             max_len: Optional[int] = None,
+             kv_dtype: Optional[str] = None) -> jnp.ndarray:
     """prompt [B, Tp] → generated ids [B, max_new_tokens].
 
     One compiled program: prefill over the prompt, then a lax.scan of
     single-token decode steps with in-place cache writes. Greedy by default;
     ``temperature``/``top_k``/``top_p`` enable sampling (``key`` required
-    then).
+    then). ``kv_dtype`` narrows the cache storage dtype (init_cache).
     """
     b, tp = prompt.shape
     assert max_new_tokens >= 1, max_new_tokens
@@ -191,7 +201,7 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     if key is None:
         assert temperature == 0.0, "sampling (temperature>0) requires a key"
         key = jax.random.PRNGKey(0)   # unused by greedy argmax
-    cache = init_cache(cfg, b, max_len)
+    cache = init_cache(cfg, b, max_len, kv_dtype)
     fused = _fuse_blocks(params["blocks"])   # once, hoisted out of the scan
     logits, cache = _forward_fused(params, fused, prompt, cache, 0, cfg)
     key, sub = jax.random.split(key)

@@ -154,3 +154,22 @@ def test_generate_with_sharded_params_and_batch(params, devices):
     prompt_sh = jax.device_put(prompt, NamedSharding(mesh, P("data")))
     got = generate.generate(p_sh, prompt_sh, CFG, 6)
     assert jnp.array_equal(want, got)
+
+
+def test_bf16_kv_cache_close_to_fp32(params):
+    """kv_dtype="bfloat16" halves cache storage (the serving lever measured
+    in bench.py's decode sidebar); the decode must stay the same computation
+    up to bf16 rounding of cached K/V: logits within bf16 tolerance, and
+    greedy tokens identical for a short horizon at this scale."""
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 6), 0,
+                                CFG.vocab_size)
+    out32 = generate.generate(params, prompt, CFG, 8)
+    out16 = generate.generate(params, prompt, CFG, 8, kv_dtype="bfloat16")
+    assert out16.dtype == out32.dtype
+    assert (out16 == out32).mean() > 0.9  # rounding may flip a near-tie
+
+    cache = generate.init_cache(CFG, 2, 8, "bfloat16")
+    assert cache["k"].dtype == jnp.bfloat16
+    logits16, _ = generate.forward_cached(params, prompt, cache, 0, CFG)
+    full = llama.forward(params, prompt, CFG)[:, -1, :]
+    assert jnp.allclose(logits16, full, atol=0.05)
