@@ -21,6 +21,15 @@ def _mpl():
     return plt
 
 
+def _save(fig, plt, csv_name: str, out_name: Optional[str] = None) -> str:
+    out = os.path.join(common.RESULTS_DIR,
+                       out_name or csv_name.replace(".csv", ".png"))
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    plt.close(fig)
+    return out
+
+
 def plot_fl_curves(csv_name: str, out_name: Optional[str] = None,
                    group_cols=("algorithm", "N", "C")) -> Optional[str]:
     """Per-round test-accuracy curves, one line per config group."""
@@ -42,11 +51,7 @@ def plot_fl_curves(csv_name: str, out_name: Optional[str] = None,
     ax.set_title(csv_name.replace(".csv", ""))
     ax.legend(fontsize=7, ncol=2)
     ax.grid(alpha=0.3)
-    out = os.path.join(common.RESULTS_DIR, out_name or csv_name.replace(".csv", ".png"))
-    fig.tight_layout()
-    fig.savefig(out, dpi=120)
-    plt.close(fig)
-    return out
+    return _save(fig, plt, csv_name, out_name)
 
 
 def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None,
@@ -72,15 +77,43 @@ def plot_loss_curve(csv_name: str, x: str, ys, out_name: Optional[str] = None,
     ax.set_title(csv_name.replace(".csv", ""))
     ax.legend(fontsize=8)
     ax.grid(alpha=0.3)
-    out = os.path.join(common.RESULTS_DIR, out_name or csv_name.replace(".csv", ".png"))
-    fig.tight_layout()
-    fig.savefig(out, dpi=120)
-    plt.close(fig)
-    return out
+    return _save(fig, plt, csv_name, out_name)
+
+
+def plot_backdoor(csv_name: str = "hw3_backdoor.csv",
+                  out_name: Optional[str] = None) -> Optional[str]:
+    """Two panels per defense: clean accuracy and backdoor ASR per round —
+    the visual signature of the reference's cells 27-31 (undefended ASR
+    climbs to ~1 while clean accuracy looks fine; robust rules pin ASR)."""
+    import pandas as pd
+    path = os.path.join(common.RESULTS_DIR, csv_name)
+    if not os.path.exists(path):
+        return None
+    try:
+        df = pd.read_csv(path)
+    except pd.errors.EmptyDataError:
+        return None
+    if not {"defense", "round", "clean_accuracy",
+            "backdoor_asr"} <= set(df.columns):
+        return None      # partial/older schema must not sink main()'s list
+    plt = _mpl()
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.2), sharex=True)
+    for d, g in df.groupby("defense", sort=False):
+        g = g.sort_values("round")
+        ax1.plot(g["round"], g["clean_accuracy"], marker="o", ms=3, label=d)
+        ax2.plot(g["round"], g["backdoor_asr"], marker="o", ms=3, label=d)
+    ax1.set_title("clean test accuracy")
+    ax2.set_title("backdoor attack success rate")
+    for ax in (ax1, ax2):
+        ax.set_xlabel("round")
+        ax.grid(alpha=0.3)
+    ax2.legend(fontsize=7, ncol=2)
+    return _save(fig, plt, csv_name, out_name)
 
 
 def main() -> list:
     made = [
+        plot_backdoor(),
         # n_train separates the 12k battery from matched-shard 60k appends.
         plot_fl_curves("hw1_fl.csv",
                        group_cols=("algorithm", "N", "C", "n_train")),

@@ -123,3 +123,51 @@ def test_provenance_labels():
     assert common.heart_provenance() in ("heart-real", "heart-synthetic")
     assert common.tinystories_provenance() in (
         "tinystories-real", "tinystories-synthetic")
+
+
+def test_hw3_backdoor_run_one_records_clean_and_asr(tmp_path):
+    """The backdoor runner's per-round record carries both metrics and the
+    protocol metadata (experiments/hw3_backdoor.py)."""
+    from unittest import mock
+
+    from ddl25spring_tpu.utils.tracing import ResultSink
+
+    from experiments import hw3_backdoor
+
+    sink = ResultSink(str(tmp_path / "bkd.csv"))
+    small = dict(hw3_backdoor.HW3, nr_clients=10, client_fraction=0.4,
+                 batch_size=20, epochs=1)
+    with mock.patch.dict(hw3_backdoor.HW3, small, clear=True):
+        res = hw3_backdoor.run_one("median", sink, "mnist-synthetic",
+                                   rounds=2, n_train=200, n_test=80)
+    assert 0.0 <= res["clean"] <= 1.0 and 0.0 <= res["asr"] <= 1.0
+    df = sink.read_df()
+    assert len(df) == 2
+    assert {"clean_accuracy", "backdoor_asr", "defense", "round"} <= set(df.columns)
+    assert set(df["defense"]) == {"median"}
+
+
+def test_vfl_faithful_freezes_bottoms():
+    """The dominant reference quirk (train/vfl.py): with train_bottoms=False
+    the bottom models' parameters are bit-identical after training while the
+    top still learns."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.config import VFLConfig
+    from ddl25spring_tpu.models import vfl_nets
+    from ddl25spring_tpu.train.vfl import train_vfl
+
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(80, d)).astype(np.float32) for d in (3, 4)]
+    y = rng.integers(0, 2, 80)
+    init = vfl_nets.init_vfl(jax.random.key(7), [3, 4])
+    cfg = VFLConfig(nr_clients=2, epochs=3, batch_size=20, seed=7)
+    params, _ = train_vfl(xs, y, xs, y, cfg, train_bottoms=False)
+    for a, b in zip(jax.tree.leaves(init["bottoms"]),
+                    jax.tree.leaves(params["bottoms"])):
+        assert jnp.array_equal(a, b)
+    moved = [not jnp.array_equal(a, b)
+             for a, b in zip(jax.tree.leaves(init["top"]),
+                             jax.tree.leaves(params["top"]))]
+    assert all(moved)
