@@ -197,7 +197,13 @@ def train_vfl_vae(xs_train: Sequence[np.ndarray],
 
     Full-batch per epoch with a fresh reparameterization key, matching the
     reference's training loop (Tea_Pula_HW2.ipynb cell 40; final total ≈4.10
-    = recon 3.97 + KL 0.128 with 4 clients × latent 4).
+    = recon 3.97 + KL 0.128 with 4 clients × latent 4). NOTE the reference's
+    4.10 is trained with 3 of its 4 clients' encoder/decoders FROZEN — its
+    cell-38 `add_module("client_encoder", enc)` loop registers every client
+    module under one name, so only the last client's models reach
+    `parameters()` (measured: 1,535 of 5,640 encoder params registered).
+    This trainer optimizes all parties, so its totals land far lower;
+    see PARITY.md for the attribution.
     """
     cfg = cfg or VFLConfig()
     feature_dims = [int(a.shape[1]) for a in xs_train]
