@@ -66,7 +66,8 @@ def peak_flops_per_chip() -> float:
     return 197e12  # default to v5e — this project's bench hardware
 
 
-def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused") -> float:
+def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused",
+               wire=None) -> float:
     """Tokens/sec for the DP train step at the given per-chip batch size.
 
     ``opt_name``: "fused" = single-pass fused Adam (ops/adam.py — same update
@@ -78,7 +79,7 @@ def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused") -> float:
     """
     from ddl25spring_tpu.bench_utils import time_train_step
     return time_train_step(mesh, cfg, batch_size, seq=SEQ, opt_name=opt_name,
-                           warmup=WARMUP, timed_steps=TIMED_STEPS)
+                           wire=wire, warmup=WARMUP, timed_steps=TIMED_STEPS)
 
 
 def _time_batch_one(overrides_json: str, batch: str) -> None:
@@ -97,7 +98,8 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
         print("child probe found no accelerator", file=sys.stderr)
         sys.exit(3)
     overrides = _json.loads(overrides_json)
-    opt_name = overrides.pop("_opt", "fused")  # reserved key, not a cfg field
+    opt_name = overrides.pop("_opt", "fused")  # reserved keys, not cfg fields
+    wire = overrides.pop("_wire", None)
     if opt_name == "pallas":
         # Gate the '+padam' number on a real-lowering smoke: interpret-mode
         # CPU tests validate the math, not the Mosaic compile. A broken
@@ -107,7 +109,8 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
     cfg = dataclasses.replace(LlamaConfig(dtype="bfloat16"), **overrides)
     n_dev = len(jax.devices())
     mesh = make_mesh({"data": n_dev})
-    print(time_batch(mesh, cfg, int(batch), opt_name=opt_name), n_dev)
+    print(time_batch(mesh, cfg, int(batch), opt_name=opt_name, wire=wire),
+          n_dev)
 
 
 def _time_batch_subprocess(overrides: dict, bs: int, timeout: int
@@ -162,7 +165,13 @@ def main():
                         # HBM reads of every matmul (ops/mixed_precision.py).
                         ({**flash_overrides, "param_dtype": "bfloat16",
                           "_opt": "master"},
-                         "flash-dhm+mp", (64,))]
+                         "flash-dhm+mp", (64,)),
+                        # int8+error-feedback compressed allreduce
+                        # (parallel/compress.py): on one chip this times the
+                        # quantize/EF overhead — the single-chip datum
+                        # VERDICT r4 asked for next to the multi-chip design.
+                        ({**flash_overrides, "_wire": "int8_ef"},
+                         "flash-dhm+int8ef", (64,))]
         for overrides, label, batches in pallas_sweep:
             for bs in batches:
                 try:

@@ -46,21 +46,37 @@ def make_optimizer(opt_name: str, lr: float = 8e-4):
 
 def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
                     seq: Optional[int] = None, opt_name: str = "fused",
+                    wire: Optional[str] = None,
                     warmup: int = 3, timed_steps: int = 20) -> float:
     """Total tokens/sec of the DP train step at the given per-chip batch.
 
     ``seq`` defaults to ``cfg.ctx_size``. The caller divides by its device
-    count for a per-chip figure."""
+    count for a per-chip figure. ``wire`` ∈ {None, "bf16", "int8_ef"}
+    selects the compressed-allreduce step (parallel/compress.py) — on one
+    chip the collective is local, so the measurement is the compression
+    math's overhead (quantize + error-feedback), the number VERDICT r4
+    asked for alongside the multi-chip design."""
     seq = seq or cfg.ctx_size
     n_dev = mesh.devices.size
     params = llama.init_llama(jax.random.key(0), cfg)
     opt = make_optimizer(opt_name)
-    state = dp.replicate(mesh, dp.init_state(params, opt))
 
     def loss_fn(p, batch):
         return llama.forward_loss(p, batch, cfg)
 
-    step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
+    if wire == "bf16":
+        from .parallel import compress
+        state = dp.replicate(mesh, dp.init_state(params, opt))
+        step = compress.make_bf16_grad_step(loss_fn, opt, mesh)
+    elif wire == "int8_ef":
+        from .parallel import compress
+        state = compress.init_ef_state(mesh, params, opt)
+        step = compress.make_int8_ef_grad_step(loss_fn, opt, mesh)
+    elif wire is None:
+        state = dp.replicate(mesh, dp.init_state(params, opt))
+        step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
+    else:
+        raise ValueError(f"unknown wire {wire!r}")
     tokens = jax.random.randint(jax.random.key(1), (n_dev * batch_size, seq),
                                 0, cfg.vocab_size)
     batch = dp.shard_batch(mesh, tokens)
