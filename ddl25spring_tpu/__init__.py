@@ -21,6 +21,8 @@ Subpackages:
   models    — functional model zoo (tiny-Llama, MnistCnn, MLPs, VAE, VFL nets)
   ops       — losses, attention, collective helpers, Pallas kernels
   parallel  — DP / PP / TP / SP strategies and the FL client/server suite
+  resilience— fault injection (FaultPlan) + self-healing (StepGuard, retry,
+              preemption handling) for every training path
   utils     — pytree helpers, timing, checkpointing, logging
 """
 

@@ -22,6 +22,9 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from .metrics import ResilienceStats
+from .resilience.retry import retry_call
+
 
 class Checkpointer:
     """Thin wrapper over an orbax CheckpointManager.
@@ -32,22 +35,59 @@ class Checkpointer:
         ckpt.save(int(state.step), state)          # async-capable save
         state = ckpt.restore(template_state)       # into template's sharding
         step = ckpt.latest_step()                  # None if nothing saved
+
+    Robustness contract (resilience layer): ``save`` retries transient IO
+    failures with exponential backoff; ``restore`` falls back past a
+    corrupt/unreadable step to the newest step that restores cleanly —
+    counted in ``stats.ckpt_fallbacks`` — so a checkpoint truncated by a
+    mid-write kill costs ``checkpoint_every`` steps of progress, never the
+    run. ``max_to_keep >= 2`` is what makes the fallback non-vacuous.
     """
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3):
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 retry_attempts: int = 3, retry_base_delay: float = 0.1,
+                 stats: Optional[ResilienceStats] = None):
+        self._retry_attempts = max(1, retry_attempts)
+        self._retry_base = retry_base_delay
+        self.stats = stats if stats is not None else ResilienceStats()
+        self.restored_step: Optional[int] = None  # set by restore()
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(directory),
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True),
         )
 
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+    def _count_retry(self, attempt: int, exc: BaseException) -> None:
+        self.stats.retries += 1
+
+    def save(self, step: int, state: Any, *, force: bool = False,
+             overwrite: bool = False) -> bool:
         """Persist a pytree (e.g. a TrainState) at ``step``. Returns as soon
         as the arrays are snapshotted; serialization/IO continues in the
         background (orbax async) — call ``wait()`` to block, or rely on the
-        lazy waits in restore()/close()."""
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                              force=force)
+        lazy waits in restore()/close(). Transient failures (disk pressure,
+        a previous async save erroring out at the enqueue barrier) are
+        retried with backoff before surfacing.
+
+        ``overwrite=True`` deletes any existing step ``step`` first. Only
+        for callers re-treading step indices after a corrupt-latest fallback
+        resume: the on-disk entry is then a stale (possibly the corrupt)
+        remnant of the pre-fallback lineage, and a blind save would be an
+        orbax StepAlreadyExistsError. Default False so double-save bugs
+        still fail loudly."""
+        if step in self.all_steps():
+            if not overwrite:
+                # Fail fast and outside the retry loop: a double-save is a
+                # deterministic caller bug, and retrying it would both delay
+                # the failure and count phantom IO retries into the stats.
+                raise ValueError(
+                    f"checkpoint step {step} already exists "
+                    f"(pass overwrite=True to replace a stale entry)")
+            self._mgr.delete(step)
+        return retry_call(
+            self._mgr.save, step, args=ocp.args.StandardSave(state),
+            force=force, attempts=self._retry_attempts,
+            base=self._retry_base, seed=step, on_retry=self._count_retry)
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -57,12 +97,14 @@ class Checkpointer:
 
         ``template`` is a live pytree with the desired layout (typically a
         freshly built TrainState on the current mesh — its values are only
-        read for shape/sharding). Defaults to the latest step.
+        read for shape/sharding). Defaults to the latest step; if that step
+        is corrupt/unreadable (truncated by a kill, garbled on disk), falls
+        back to the next-newest step that restores cleanly — each skipped
+        step counts into ``stats.ckpt_fallbacks``. An explicitly requested
+        ``step`` does NOT fall back: the caller named it, so failing loudly
+        is correct.
         """
         self._mgr.wait_until_finished()   # flush any in-flight async save
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError("no checkpoint found")
 
         def abstract(x):
             if isinstance(x, jax.Array):
@@ -70,14 +112,40 @@ class Checkpointer:
             return x
 
         target = jax.tree.map(abstract, template)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
-        # Belt-and-braces: orbax can return scalar/replicated leaves on a
-        # single device; re-place every leaf into the template's sharding so
-        # the result is directly usable by the mesh-compiled train step.
-        return jax.tree.map(
-            lambda r, t: (jax.device_put(r, t.sharding)
-                          if isinstance(t, jax.Array) else r),
-            restored, template)
+
+        def place(restored):
+            # Belt-and-braces: orbax can return scalar/replicated leaves on
+            # a single device; re-place every leaf into the template's
+            # sharding so the result is directly usable by the mesh-compiled
+            # train step.
+            return jax.tree.map(
+                lambda r, t: (jax.device_put(r, t.sharding)
+                              if isinstance(t, jax.Array) else r),
+                restored, template)
+
+        if step is not None:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target))
+            self.restored_step = step  # only after the restore succeeded
+            return place(restored)
+
+        candidates = sorted(self.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError("no checkpoint found")
+        last_exc: Optional[BaseException] = None
+        for s in candidates:
+            try:
+                restored = self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(target))
+            except Exception as e:  # corrupt/truncated/garbled step
+                last_exc = e
+                self.stats.ckpt_fallbacks += 1
+                continue
+            self.restored_step = s  # which step actually won (≤ latest_step)
+            return place(restored)
+        raise FileNotFoundError(
+            f"all {len(candidates)} checkpoint steps failed to restore "
+            f"(newest error: {last_exc!r})") from last_exc
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -97,10 +165,28 @@ class Checkpointer:
 
 def save_best(path: str, params: Any) -> None:
     """The reference's best-weights idiom (centralized.py:51) as a one-shot
-    file save: host-gather params and write an .npz."""
+    file save: host-gather params and write an .npz.
+
+    Atomic: the archive is written to a temp file in the target directory
+    and ``os.replace``d into place, so a mid-write kill leaves either the
+    previous best intact or the new one — never a truncated .npz (np.savez
+    writes incrementally, so a plain in-place save can be killed half-way)."""
+    import tempfile
+
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     arrays = {jax.tree_util.keystr(p): np.asarray(v) for p, v in flat}
-    np.savez(path, **arrays)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)), suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_best(path: str, template: Any) -> Any:

@@ -155,6 +155,33 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Self-healing knobs for the training loops (resilience/).
+
+    Passed to the LLM trainers (``resilience=``) and honored by bench /
+    experiment drivers. ``faults`` is a FaultPlan spec string (see
+    resilience/faults.py) so injection runs are configurable from a CLI
+    flag; empty means inject nothing. Defaults are the production posture:
+    guard on, detector warmed up past optimizer-startup transients.
+    """
+
+    guard: bool = True             # wrap the train step in a StepGuard
+    max_consecutive_bad: int = 3   # K consecutive bad steps → rollback
+    ema_decay: float = 0.98        # update-norm EMA smoothing
+    anomaly_factor: float = 10.0   # spike threshold (×EMA); <=0 disables
+    ema_warmup: int = 20           # good steps before the detector arms
+    retry_attempts: int = 3        # checkpoint-IO retry budget
+    retry_base_delay: float = 0.1  # seconds; doubles per attempt, jittered
+    faults: str = ""               # FaultPlan spec for injection runs
+    fault_seed: int = 0            # drives every random fault choice
+
+    def fault_plan(self):
+        """The configured FaultPlan (empty spec → empty plan)."""
+        from .resilience.faults import FaultPlan
+        return FaultPlan.from_spec(self.faults, seed=self.fault_seed)
+
+
+@dataclass(frozen=True)
 class VFLConfig:
     """Vertical FL / split learning configuration (reference:
     lab/tutorial_2b/vfl.py:159-168 — 4 clients, 300 epochs, batch 64)."""

@@ -28,10 +28,26 @@ _lib = None
 
 
 def _build() -> bool:
-    try:
+    """One build attempt, retried with backoff: `make` can fail transiently
+    (a concurrent build holding an output half-written despite the flock —
+    e.g. a watchdog-killed builder's stale artifacts — or memory pressure on
+    the oversubscribed host), and the retry turns those into a pause instead
+    of a session-long silent fallback to the Python stream. A build that
+    *hangs* to its 300 s timeout is not retried — it already proved it won't
+    finish, and two more 300 s waits would blow the tier-1 suite's wall-time
+    budget (.github/workflows/tier1.yml)."""
+    from ..resilience.retry import retry_call
+
+    def attempt() -> None:
         subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
                        check=True, capture_output=True, timeout=300)
-        return os.path.exists(_LIB_PATH)
+        if not os.path.exists(_LIB_PATH):
+            raise OSError(f"make succeeded but {_LIB_PATH} missing")
+
+    try:
+        retry_call(attempt, attempts=3, base=0.5, max_delay=5.0,
+                   retry_on=(subprocess.CalledProcessError, OSError))
+        return True
     except Exception:
         return False
 

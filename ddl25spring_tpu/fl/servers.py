@@ -23,6 +23,15 @@ The aggregation point is an explicit hook (``defense=``): selection defenses
 (Krum family) return surviving client indices; aggregation defenses
 (median family) replace the weighted mean entirely — mirroring the
 FedAvgServerDefense / FedAvgServerDefenseCoordinate split (cells 34, 43).
+
+Benign faults (resilience layer): every server accepts ``fault_plan=`` — a
+resilience.FaultPlan scheduling client dropout/straggling per round. The
+round then aggregates over the survivors with renormalized sample-count
+weights (an all-clients-lost round is skipped, params unchanged), and the
+drop/straggle/skip counters land in ``server.resilience``. This is the
+paper's Byzantine story (§6) extended to the *infrastructure* fault class:
+a vanished client is handled by the same aggregation point as a malicious
+one, but by re-weighting instead of by defense.
 """
 
 from __future__ import annotations
@@ -38,7 +47,7 @@ import numpy as np
 
 from .. import rng as rngmod
 from ..config import FLConfig
-from ..metrics import RunResult, message_count
+from ..metrics import ResilienceStats, RunResult, message_count
 from ..utils import pytree as pt
 from .federated_data import FederatedDataset
 from .local import full_batch_grad, local_sgd, masked_mean_loss
@@ -58,13 +67,17 @@ class _ServerBase:
 
     def __init__(self, init_params: PyTree, apply_fn, data: FederatedDataset,
                  test_x: jnp.ndarray, test_y: jnp.ndarray, cfg: FLConfig,
-                 algorithm: str):
+                 algorithm: str, fault_plan=None):
         self.apply_fn = apply_fn
         self.params = init_params
         self.data = data
         self.test_x = jnp.asarray(test_x)
         self.test_y = jnp.asarray(test_y)
         self.cfg = cfg
+        # Benign-fault injection (resilience.FaultPlan): scheduled client
+        # dropout/straggling per round. Counters in ``self.resilience``.
+        self.fault_plan = fault_plan
+        self.resilience = ResilienceStats()
         self.result = RunResult(algorithm, cfg.nr_clients, cfg.client_fraction,
                                 cfg.batch_size, cfg.epochs, cfg.lr, cfg.seed)
 
@@ -98,6 +111,31 @@ class _ServerBase:
 
     def _round(self, params, r):
         idx = self._sample(r)
+        if self.fault_plan is not None:
+            # Benign faults: scheduled clients vanish (dropped) or miss the
+            # round deadline (stragglers). The round re-weights aggregation
+            # over the survivors by filtering ``idx`` on the host — the
+            # sample-count weights renormalize over whoever is left, and
+            # every defense hook sees only updates that actually arrived.
+            # Deterministic under the plan's seed; and because client seeds
+            # use the GLOBAL client index (hfl_complete.py:364), a
+            # survivor's local randomness is identical whether or not its
+            # peers dropped — the surviving contributions are bit-identical
+            # to the fault-free round's. Known cost: each distinct survivor
+            # count is a new len(idx), so the vmapped round step retraces
+            # once per count — acceptable for rare faulted rounds; padding
+            # idx with zero weights would hold one shape if chaos runs with
+            # per-round-varying dropout ever dominate.
+            mask, dropped, stragglers = \
+                self.fault_plan.surviving_clients(r, idx)
+            self.resilience.dropped_clients += dropped
+            self.resilience.straggler_clients += stragglers
+            if not mask.any():
+                # Every sampled client vanished: skip the round (params
+                # unchanged) rather than dividing by zero arrivals.
+                self.resilience.skipped_rounds += 1
+                return params
+            idx = idx[mask]
         # Per-(client, round) PRNG keys from the reference seed formula:
         # dropout inside local training (the reference trains in train mode,
         # hfl_complete.py:72,271,351) and any data poisoning fold from these.

@@ -61,6 +61,37 @@ class RunResult:
         )
 
 
+@dataclass
+class ResilienceStats:
+    """Fault-handling counters shared by the resilience layer (resilience/):
+    StepGuard skip/rollback accounting, retry_call retries, Checkpointer
+    restore fallbacks, FL survivor re-weighting, and preemption force-saves.
+    One instance threads through a run; ``as_dict`` lands in bench JSON and
+    experiment CSVs so a fault-free run's zeros are visible evidence."""
+
+    skipped_steps: int = 0       # StepGuard: non-finite loss/params → no-op
+    anomalies: int = 0           # StepGuard: EMA update-norm outliers
+    rollbacks: int = 0           # StepGuard: K consecutive bad → restore
+    retries: int = 0             # retry_call invocations that re-tried IO
+    ckpt_fallbacks: int = 0      # Checkpointer.restore skipped corrupt steps
+    dropped_clients: int = 0     # FL: vanished clients excluded from rounds
+    straggler_clients: int = 0   # FL: over-deadline clients excluded
+    skipped_rounds: int = 0      # FL: rounds with zero surviving clients
+    preemptions: int = 0         # SIGTERM force-save exits
+
+    def as_dict(self) -> dict:
+        return {k: int(v) for k, v in self.__dict__.items()}
+
+    def merge(self, other: "ResilienceStats") -> "ResilienceStats":
+        for k, v in other.__dict__.items():
+            setattr(self, k, getattr(self, k) + v)
+        return self
+
+    @property
+    def total_faults_handled(self) -> int:
+        return sum(self.__dict__.values())
+
+
 def message_count(round_idx: int, clients_per_round: int) -> int:
     """Cumulative messages after round ``round_idx`` (0-based):
     ``2·(round+1)·m`` (reference: hfl_complete.py:383)."""

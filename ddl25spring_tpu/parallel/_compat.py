@@ -1,0 +1,39 @@
+"""JAX API-drift shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (where its
+replication-check kwarg is ``check_rep``) to ``jax.shard_map`` (where it is
+``check_vma``). This repo pins neither world: the container decides the jax
+version, and the resilience posture is to degrade gracefully, not abort on
+import. Every step factory in parallel/ routes through this one shim, so
+the call sites keep the modern ``check_vma`` spelling and older jaxlibs
+transparently get ``check_rep``.
+
+``lax.axis_size`` is the same story: absent before jax 0.5, where the
+static size of a mapped axis comes from ``core.axis_frame`` instead (which
+itself drifted — older builds return a frame object with ``.size``, 0.4.37
+returns the int directly).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # pre-move jax: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name) -> int:
+        frame = jax.core.axis_frame(axis_name)
+        return frame if isinstance(frame, int) else frame.size

@@ -45,6 +45,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
+
 from .dp import TrainState, apply_optimizer, init_state, replicate
 
 
@@ -72,7 +74,7 @@ def make_bf16_grad_step(loss_fn: Callable,
                                             state.opt_state, state.params)
         return TrainState(params, opt_state, state.step + 1), loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P("data")), out_specs=(P(), P()),
         check_vma=False)
@@ -159,7 +161,7 @@ def make_int8_ef_grad_step(loss_fn: Callable,
         return EFTrainState(params, opt_state, state.step + 1, residual), loss
 
     state_specs = EFTrainState(P(), P(), P(), P("data"))
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(state_specs, P("data")),
         out_specs=(state_specs, P()),

@@ -27,6 +27,19 @@ from jax.sharding import Mesh
 from .mesh import AXES
 
 
+def _is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with a fallback for jax builds
+    that predate it (same API-drift posture as parallel/_compat.py): the
+    distributed client living in jax's global state is the signal."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
@@ -42,7 +55,7 @@ def initialize(coordinator_address: Optional[str] = None,
     here would itself initialize the backend and make the rendezvous
     impossible.
     """
-    if jax.distributed.is_initialized():
+    if _is_initialized():
         return
     kw = {}
     if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):

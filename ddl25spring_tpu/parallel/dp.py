@@ -24,6 +24,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
+
 
 class TrainState(NamedTuple):
     params: Any
@@ -76,7 +78,8 @@ def apply_optimizer(optimizer, grads, opt_state, params):
 
 
 def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
-                               mesh: Mesh, accum_steps: int = 1) -> Callable:
+                               mesh: Mesh, accum_steps: int = 1,
+                               guard_nonfinite: bool = False) -> Callable:
     """jit-compiled SPMD step: local grads -> pmean over ``data`` -> update.
 
     ``loss_fn(params, batch) -> scalar``. The batch's leading axis is sharded
@@ -90,6 +93,18 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
     activation memory, with unchanged collective traffic. The local batch's
     leading dim must divide evenly. Equivalent to the full-batch step up to
     float re-association (asserted in tests/test_dp.py).
+
+    ``guard_nonfinite=True`` fuses a post-allreduce finiteness guard into
+    the step (resilience layer): if the *averaged* gradient or loss carries
+    a NaN/Inf — one poisoned shard poisons the pmean for everyone, which is
+    exactly why the check sits after the collective — the update is a
+    select-back to the incoming params/opt state and ``step`` does not
+    advance. Zero host syncs and donation-safe (the select happens inside
+    the jitted program), so it composes with compressed-wire and accum
+    variants of the surrounding loop; the skipped step is visible to the
+    host as the returned non-finite loss and the non-advancing ``step``.
+    The host-side StepGuard (resilience/guard.py) layers EMA anomaly
+    detection and checkpoint rollback on top when those are wanted.
     """
 
     def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
@@ -120,9 +135,22 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
         loss = lax.pmean(loss, "data")
         params, opt_state = apply_optimizer(optimizer, grads,
                                             state.opt_state, state.params)
+        if guard_nonfinite:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                ok &= jnp.all(jnp.isfinite(g))
+            # Select-back, not zeroed grads: a zero-grad optimizer update
+            # still decays Adam moments and bumps count — only keeping the
+            # incoming state makes the skip a true no-op.
+            params = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                  params, state.params)
+            opt_state = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                     opt_state, state.opt_state)
+            return TrainState(params, opt_state,
+                              state.step + ok.astype(state.step.dtype)), loss
         return TrainState(params, opt_state, state.step + 1), loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P("data")),
@@ -151,7 +179,7 @@ def make_weight_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTra
         loss = lax.pmean(loss, "data")
         return TrainState(params, opt_state, state.step + 1), loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P("data")),
@@ -212,7 +240,7 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         mine = lax.dynamic_slice_in_dim(flat, shard * local, local)
         return optimizer.init(mine)
 
-    opt_state = jax.jit(jax.shard_map(
+    opt_state = jax.jit(shard_map(
         local_init, mesh=mesh, in_specs=P(),
         out_specs=opt_specs, check_vma=False))(params)
     state = TrainState(replicate(mesh, params), opt_state,
@@ -240,7 +268,7 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         loss = lax.pmean(loss, "data")
         return TrainState(new_params, opt_state, state.step + 1), loss
 
-    step = jax.shard_map(
+    step = shard_map(
         local_step, mesh=mesh,
         in_specs=(TrainState(P(), opt_specs, P()), P("data")),
         out_specs=(TrainState(P(), opt_specs, P()), P()),

@@ -25,6 +25,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
+
 from ..config import MoEConfig
 from ..models import moe
 from ..ops import causal_lm_loss
@@ -92,7 +94,7 @@ def make_ep_train_step(cfg: MoEConfig, optimizer: optax.GradientTransformation,
 
     def step(state: TrainState, tokens):
         pspecs = param_specs(state.params)
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             sharded_grads, mesh=mesh,
             in_specs=(pspecs, P("data") if has_data else P()),
             out_specs=(P(), pspecs),
@@ -112,7 +114,7 @@ def _ep_forward_fn(cfg: MoEConfig, mesh: Mesh) -> Callable:
         return logits, aux
 
     def fn(params, tokens):
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(param_specs(params), P()),
             out_specs=(P(), P()),

@@ -46,6 +46,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
+
 from ..config import LlamaConfig
 from ..models import llama
 from .dp import TrainState, apply_optimizer, sharded_opt_init
@@ -490,7 +492,7 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
 
     def step(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
         specs = param_specs(state.params, tp=tp > 1)
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             sharded_grads, mesh=mesh,
             in_specs=(specs, P("data") if has_data else P()),
             out_specs=(P(), specs),

@@ -45,6 +45,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import axis_size, shard_map
+
 from ..config import LlamaConfig
 from ..models import llama
 from .dp import TrainState, sharded_opt_init
@@ -62,7 +64,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``axis_index * T_local + arange(T_local)``. Returns [B, T_local, H, Dh] —
     each query attends over the FULL global sequence (causally masked).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     s = lax.axis_index(axis_name)
     b, tl, h, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
@@ -151,7 +153,7 @@ def _sp_loss(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
 @functools.cache
 def _sp_forward_fn(cfg: LlamaConfig, mesh: Mesh, n_seq: int) -> Callable:
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, tok: _sp_logits(p, tok, cfg, n_seq),
         mesh=mesh, in_specs=(P(), P()), out_specs=P(None, "seq"),
         check_vma=False,
@@ -202,7 +204,7 @@ def make_sp_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P("data") if has_data else P()),
         out_specs=(P(), P()),

@@ -36,6 +36,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
+
 from ..config import LlamaConfig
 from ..models import llama
 from ..ops import causal_lm_loss
@@ -126,7 +128,7 @@ def make_tp_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
 
     def step(state: TrainState, tokens):
         pspecs = param_specs(state.params)
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             sharded_grads, mesh=mesh,
             in_specs=(pspecs, P("data") if has_data else P()),
             out_specs=(P(), pspecs),
@@ -154,7 +156,7 @@ def _tp_forward_fn(cfg: LlamaConfig, mesh: Mesh) -> Callable:
         return llama.head(params, h, cfg)
 
     def fn(params, tokens):
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(param_specs(params), P()),
             out_specs=P(),

@@ -1,0 +1,217 @@
+"""Deterministic fault injection: the adversary half of the resilience layer.
+
+A ``FaultPlan`` is a seedable, fully deterministic schedule of benign faults
+— the infrastructure counterpart of fl/attacks.py's Byzantine adversaries.
+It can, at chosen steps/rounds:
+
+- corrupt gradients (``nan_grad`` / ``inf_grad`` / ``spike_grad``) by
+  wrapping a train step (`wrap_step`) so the post-update state and loss are
+  poisoned exactly as a non-finite or exploded gradient would poison them;
+- drop (``drop_client``) or time out (``delay_client``) FL clients for a
+  round — the servers re-weight aggregation over the survivors;
+- corrupt the newest checkpoint on disk (`corrupt_latest_checkpoint`);
+- deliver a simulated preemption (``preempt``: SIGTERM to this process) at
+  a step boundary.
+
+Plans parse from a compact spec string so bench.py / experiments can take
+them straight off a CLI flag or config field::
+
+    "nan_grad@10"                 NaN gradient at step 10
+    "spike_grad@5:100"            gradient scaled by 100 at step 5
+    "preempt@25"                  SIGTERM delivered before step 25
+    "drop_client@3:2"             2 clients vanish in round 3
+    "delay_client@1:1"            1 client straggles past deadline, round 1
+    "nan_grad@10,preempt@25"      comma-composed
+
+Determinism contract: the same (spec, seed) always injects the same faults
+on the same steps and picks the same client subsets — tests rely on it, and
+so does "replay the incident" debugging.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+GRAD_FAULTS = ("nan_grad", "inf_grad", "spike_grad")
+CLIENT_FAULTS = ("drop_client", "delay_client")
+KINDS = GRAD_FAULTS + CLIENT_FAULTS + ("preempt", "corrupt_ckpt")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    kind: str        # one of KINDS
+    step: int        # train step (grad/preempt) or FL round (client faults)
+    arg: float = 0.0  # spike scale / client count / unused
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(expected one of {KINDS})")
+
+
+def parse_spec(spec: str) -> List[FaultEvent]:
+    """``"kind@step[:arg],..."`` -> events. Whitespace-tolerant; empty spec
+    -> no events."""
+    events: List[FaultEvent] = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "@" not in part:
+            raise ValueError(f"fault spec {part!r} lacks '@step'")
+        kind, _, rest = part.partition("@")
+        step_s, _, arg_s = rest.partition(":")
+        events.append(FaultEvent(kind.strip(), int(step_s),
+                                 float(arg_s) if arg_s else 0.0))
+    return events
+
+
+@dataclass
+class FaultPlan:
+    """A deterministic fault schedule plus the injection mechanics.
+
+    ``events``: what happens when. ``seed``: drives every random choice the
+    plan makes (which clients drop) — two plans with equal (events, seed)
+    behave identically. An empty plan injects nothing and wraps steps as
+    identity, so it is safe to thread through fault-free runs.
+    """
+
+    events: List[FaultEvent] = field(default_factory=list)
+    seed: int = 0
+
+    @classmethod
+    def from_spec(cls, spec: str, *, seed: int = 0) -> "FaultPlan":
+        return cls(parse_spec(spec), seed=seed)
+
+    def __bool__(self) -> bool:
+        return bool(self.events)
+
+    # ----------------------------------------------------------- queries
+
+    def _at(self, kinds: Tuple[str, ...], step: int) -> Optional[FaultEvent]:
+        for e in self.events:
+            if e.kind in kinds and e.step == step:
+                return e
+        return None
+
+    def grad_fault_at(self, step: int) -> Optional[FaultEvent]:
+        return self._at(GRAD_FAULTS, step)
+
+    def preempt_at(self, step: int) -> bool:
+        return self._at(("preempt",), step) is not None
+
+    def surviving_clients(self, round_idx: int,
+                          sampled_idx: np.ndarray) -> Tuple[np.ndarray, int, int]:
+        """(bool mask over ``sampled_idx``, n_dropped, n_stragglers) for this
+        round. Which of the sampled clients vanish/straggle is a seeded
+        choice over the sampled set — deterministic per (plan, round), and
+        independent of array memory layout. At least one survivor is kept
+        whenever possible is NOT guaranteed: a plan may kill the whole
+        round; servers handle the empty round by skipping it."""
+        mask = np.ones(len(sampled_idx), dtype=bool)
+        dropped = stragglers = 0
+        for kind in CLIENT_FAULTS:
+            e = self._at((kind,), round_idx)
+            if e is None:
+                continue
+            n = max(1, int(e.arg)) if e.arg else 1
+            n = min(n, int(mask.sum()))
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, round_idx,
+                                        CLIENT_FAULTS.index(kind)]))
+            victims = rng.choice(np.flatnonzero(mask), size=n, replace=False)
+            mask[victims] = False
+            if kind == "drop_client":
+                dropped += n
+            else:
+                stragglers += n
+        return mask, dropped, stragglers
+
+    # --------------------------------------------------------- injection
+
+    def wrap_step(self, step_fn, stats=None):
+        """Wrap ``step_fn(state, batch) -> (state, loss)`` so grad faults and
+        simulated preemptions fire at their scheduled steps.
+
+        The wrapper counts calls itself (step indices are call indices from
+        the wrap point). Gradient faults poison the *outputs* exactly as the
+        corrupted gradient would have: ``nan_grad``/``inf_grad`` make every
+        updated param and the loss NaN/Inf (any standard optimizer update
+        propagates a non-finite gradient into every touched coordinate);
+        ``spike_grad`` re-applies the step's parameter delta scaled by
+        ``arg`` (default 100x) — the update a ``arg``-times-larger gradient
+        step would have produced under SGD-like geometry, which is what an
+        EMA update-norm detector must catch. Preemption sends SIGTERM to
+        this process BEFORE the step runs, modeling the scheduler's kill
+        landing at a step boundary.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from .guard import _tree_copy
+
+        counter = {"step": 0}
+
+        def wrapped(state, batch):
+            step = counter["step"]
+            counter["step"] += 1
+            if self.preempt_at(step):
+                os.kill(os.getpid(), signal.SIGTERM)
+            e = self.grad_fault_at(step)
+            old_params = None
+            if e is not None and e.kind == "spike_grad":
+                # Snapshot BEFORE the step: every step factory donates its
+                # input state, so the pre-step params are gone afterwards.
+                # Fault-free steps pay nothing.
+                old_params = _tree_copy(state.params)
+            new_state, loss = step_fn(state, batch)
+            if e is None:
+                return new_state, loss
+            if e.kind == "spike_grad":
+                scale = e.arg if e.arg else 100.0
+                params = jax.tree.map(
+                    lambda old, new: old + scale * (new - old),
+                    old_params, new_state.params)
+                loss = loss * scale
+            else:
+                bad = jnp.nan if e.kind == "nan_grad" else jnp.inf
+                params = jax.tree.map(lambda p: jnp.full_like(p, bad),
+                                      new_state.params)
+                loss = jnp.full_like(loss, bad)
+            return new_state._replace(params=params), loss
+
+        return wrapped
+
+
+def corrupt_latest_checkpoint(directory: str) -> str:
+    """Corrupt the newest orbax step under ``directory`` on disk: truncate
+    and garble every data file in its tree (metadata files too), modeling a
+    mid-write kill or disk fault. Returns the corrupted step's path.
+    Deterministic: the same directory state is corrupted the same way."""
+    steps = []
+    for name in os.listdir(directory):
+        p = os.path.join(directory, name)
+        # Committed orbax step dirs are bare integers; anything else
+        # ("8.orbax-checkpoint-tmp-...", metadata dirs) is not a step and
+        # must not be selected — corrupting a leftover tmp dir would leave
+        # the real latest intact and the injected fault would test nothing.
+        if os.path.isdir(p) and name.isdigit():
+            steps.append((int(name), p))
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {directory}")
+    _, latest = max(steps)
+    corrupted = False
+    for root, _, files in os.walk(latest):
+        for fname in files:
+            path = os.path.join(root, fname)
+            size = os.path.getsize(path)
+            with open(path, "r+b" if size else "wb") as f:
+                f.truncate(size // 2)
+                f.seek(0, os.SEEK_END)
+                f.write(b"\x00CORRUPT\x00")
+            corrupted = True
+    if not corrupted:
+        raise FileNotFoundError(f"no files to corrupt under {latest}")
+    return latest

@@ -1,0 +1,74 @@
+"""Cooperative preemption handling: SIGTERM → force-save → clean exit.
+
+Preemptible capacity (and this repo's own watchdogged virtual-mesh runs)
+delivers SIGTERM, not a polite API call. ``PreemptionHandler`` converts the
+signal into a flag the training loop polls at step boundaries; the loop then
+force-saves a resumable checkpoint and returns instead of dying mid-write.
+The handler chains to any previously installed handler on exit, and is a
+no-op off the main thread (Python only delivers signals to the main thread,
+and installing handlers elsewhere raises).
+
+Usage (what train/llm.py's ``_run_loop`` does)::
+
+    with PreemptionHandler() as pre:
+        for it in ...:
+            if pre.requested:
+                ckpt.save(it, state, force=True); ckpt.wait()
+                break
+            state, loss = step(state, batch)
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import List, Optional
+
+
+class PreemptionHandler:
+    """Installs handlers for ``signals`` (default: SIGTERM) that set a flag.
+
+    Re-entrant as a context manager (install/restore is exact), readable via
+    ``.requested``. A second signal while the flag is already set falls
+    through to the previous handler — so a stuck force-save can still be
+    killed by a second TERM.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._prev: List = []
+        self._event = threading.Event()
+        self._depth = 0
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def _handle(self, signum, frame):
+        if self._event.is_set():
+            prev = dict(zip(self._signals, self._prev)).get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                signal.raise_signal(signum)
+            return
+        self._event.set()
+
+    def __enter__(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signals never arrive here; stay a passive flag
+        self._depth += 1
+        if self._depth == 1:  # nested re-entry keeps the outer install
+            self._prev = [signal.signal(s, self._handle)
+                          for s in self._signals]
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return  # the matching __enter__ installed nothing
+        if self._depth > 0:
+            self._depth -= 1
+            if self._depth == 0:
+                for s, prev in zip(self._signals, self._prev):
+                    signal.signal(s, prev)

@@ -1,0 +1,88 @@
+"""Retry with exponential backoff and deterministic jitter.
+
+The framework's one retry implementation, applied where IO meets the
+kill-prone world: orbax checkpoint save/restore (checkpoint.py), the native
+tokenstream build/dlopen (data/native.py), and anything experiments want to
+harden. Deterministic by construction — the jitter stream is seeded, so a
+test (or a bit-reproducible run) sees the same delay schedule every time.
+
+Delays follow ``base * 2**attempt``, capped at ``max_delay``, each scaled by
+a jitter factor drawn uniformly from ``[1 - jitter, 1 + jitter]``. Sleeping
+is injectable (``sleep=``) so tests assert the schedule without waiting.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Iterable, List, Optional, Tuple, Type
+
+import numpy as np
+
+
+def backoff_schedule(attempts: int, *, base: float = 0.1,
+                     max_delay: float = 30.0, jitter: float = 0.25,
+                     seed: int = 0) -> List[float]:
+    """The deterministic delay sequence ``retry_call`` sleeps between tries:
+    ``min(base·2^i, max_delay) · U[1-jitter, 1+jitter]`` with a seeded RNG.
+    Exposed for tests and for callers that drive their own loops
+    (experiments/watchdog.py)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(attempts):
+        delay = min(base * (2.0 ** i), max_delay)
+        out.append(delay * float(rng.uniform(1.0 - jitter, 1.0 + jitter)))
+    return out
+
+
+def retry_call(fn: Callable, *args,
+               attempts: int = 3,
+               base: float = 0.1,
+               max_delay: float = 30.0,
+               jitter: float = 0.25,
+               seed: int = 0,
+               retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying up to ``attempts`` total tries
+    on ``retry_on`` exceptions with exponential backoff + seeded jitter.
+
+    ``on_retry(attempt_idx, exc)`` fires before each sleep — the hook the
+    callers use to count retries into ResilienceStats. The final failure
+    re-raises the last exception unchanged. KeyboardInterrupt/SystemExit are
+    never swallowed (they are not Exception subclasses).
+    """
+    attempts = max(1, attempts)
+    delays = backoff_schedule(attempts - 1, base=base, max_delay=max_delay,
+                              jitter=jitter, seed=seed)
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            last = e
+            if i == attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(i, e)
+            sleep(delays[i])
+    raise last  # unreachable; keeps type checkers honest
+
+
+def with_retry(attempts: int = 3, *, base: float = 0.1,
+               max_delay: float = 30.0, jitter: float = 0.25, seed: int = 0,
+               retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Callable[[float], None] = time.sleep) -> Callable:
+    """Decorator form of ``retry_call`` with the same semantics."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, attempts=attempts, base=base,
+                              max_delay=max_delay, jitter=jitter, seed=seed,
+                              retry_on=retry_on, on_retry=on_retry,
+                              sleep=sleep, **kwargs)
+        return wrapped
+    return deco

@@ -20,10 +20,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..config import LlamaConfig, TrainConfig
+from ..config import LlamaConfig, ResilienceConfig, TrainConfig
 from ..data.tokens import TokenStream, sharded_batches
+from ..metrics import ResilienceStats
 from ..models import llama
 from ..parallel import dp, make_mesh, pp
+from ..resilience.preemption import PreemptionHandler
 from ..tokenizers import load_tokenizer
 
 
@@ -33,6 +35,15 @@ class LLMTrainReport:
     tokens_per_sec: float = 0.0
     steps: int = 0
     wall_time: float = 0.0
+    # Resilience accounting: True if the loop exited early on a SIGTERM
+    # force-save (re-running the same call resumes); counters cover guard
+    # skips/rollbacks, checkpoint retries/fallbacks, and preemptions.
+    # ``start_step`` is the stream position losses[0] corresponds to (the
+    # resumed-from step; 0 for a fresh run) — ``iters - len(losses)`` is
+    # WRONG for a preempted run, which ends early.
+    preempted: bool = False
+    start_step: int = 0
+    resilience: Optional[ResilienceStats] = None
 
     def tokens_per_sec_per_device(self, n_devices: int) -> float:
         return self.tokens_per_sec / max(n_devices, 1)
@@ -97,19 +108,30 @@ def _make_trainer_optimizer(train_cfg: TrainConfig):
 
 
 def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
-                      log_fn: Callable[[str], None]):
-    """Shared resume preamble: open the orbax dir, restore the latest step
-    into ``state``'s layout (sharding-preserving). Returns
+                      log_fn: Callable[[str], None], *,
+                      resilience: Optional[ResilienceConfig] = None,
+                      stats: Optional[ResilienceStats] = None):
+    """Shared resume preamble: open the orbax dir, restore the newest VALID
+    step into ``state``'s layout (sharding-preserving; a corrupt latest step
+    falls back to the previous one — checkpoint.py). Returns
     ``(ckpt, state, start_step, done)`` — ``done`` means the checkpoint is
     already at/past ``iters`` and there is nothing to train."""
     if checkpoint_dir is None:
         return None, state, 0, False
     from ..checkpoint import Checkpointer
-    ckpt = Checkpointer(checkpoint_dir)
+    res = resilience or ResilienceConfig()
+    ckpt = Checkpointer(checkpoint_dir, retry_attempts=res.retry_attempts,
+                        retry_base_delay=res.retry_base_delay, stats=stats)
     start_step = 0
     if ckpt.latest_step() is not None:
         state = ckpt.restore(state)
-        start_step = int(ckpt.latest_step())
+        # The step that actually restored, NOT latest_step(): after a
+        # corrupt-step fallback they differ, and resuming the loop from the
+        # corrupt step's index would skip data the weights never saw.
+        start_step = int(ckpt.restored_step)
+        if start_step != int(ckpt.latest_step()):
+            log_fn(f"latest step {int(ckpt.latest_step())} unreadable; "
+                   f"fell back to step {start_step}")
         log_fn(f"resumed from step {start_step}")
     if start_step >= iters:
         log_fn(f"checkpoint already at step {start_step} >= iters {iters}; "
@@ -122,46 +144,123 @@ def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
 def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               n_data: int, start_step: int, ckpt, checkpoint_every: int,
               loss_sink, sink_every: int, log_every: int, log_fn,
-              warmup_steps_excluded: int) -> LLMTrainReport:
+              warmup_steps_excluded: int,
+              stats: Optional[ResilienceStats] = None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
     and async-honest throughput accounting (the timer starts after
-    ``warmup_steps_excluded`` post-resume steps, on a hard host sync)."""
+    ``warmup_steps_excluded`` post-resume steps, on a hard host sync).
+
+    Self-healing (resilience/): when a checkpointer is attached, SIGTERM is
+    caught at the next step boundary, a resumable checkpoint is force-saved,
+    and the loop returns with ``report.preempted=True`` — re-running the
+    same call resumes with data order preserved. A failed *periodic* save
+    (after its internal retries) is logged and skipped rather than killing
+    an otherwise healthy run; the final save still raises.
+
+    Step indices are STREAM positions, not gradient-update counts: a
+    StepGuard skip consumes its batch without learning from it, and a guard
+    rollback extends that to the whole faulted window (the restored weights
+    continue from the CURRENT stream position — the window's batches are
+    deliberately not replayed, mirroring skip-and-count). That is what keeps
+    resume deterministic: a checkpoint at step k always means "the stream
+    has advanced k batches", so replay-to-k reproduces the data order no
+    matter how many steps were skipped or rolled back."""
     report = LLMTrainReport()
+    report.start_step = start_step
+    report.resilience = stats if stats is not None else ResilienceStats()
     last_saved = -1
     tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
     t_start = None
     device_losses = []  # keep losses on device; a float() per step would
     #                     serialize dispatch and deflate throughput
-    for it in range(train_cfg.iters):
-        host_batch = next(batches).reshape(
-            n_data * train_cfg.batch_size, train_cfg.seq_len)
-        if it < start_step:
-            continue  # resume: replay the stream so data order is preserved
-        state, loss = step_fn(state, shard_fn(host_batch))
-        if it + 1 == start_step + warmup_steps_excluded:
-            float(loss)  # hard sync before starting the timer
-            t_start = time.perf_counter()
-        device_losses.append(loss)
-        if loss_sink is not None and (it % sink_every == 0
-                                      or it == train_cfg.iters - 1):
-            loss_sink(it, float(loss))
-        if log_every and it % log_every == 0:
-            log_fn(f"iter {it}: loss {float(loss):.4f}")
-        if ckpt is not None and (it + 1) % checkpoint_every == 0:
-            ckpt.save(it + 1, state)
-            last_saved = it + 1
+    # Installed with or without a checkpointer: an uncheckpointed run can't
+    # force-save, but it still exits the loop cleanly on SIGTERM (counters
+    # and report intact) instead of dying mid-step — a chaos run without
+    # --checkpoint-dir must demo graceful preemption, not a hard kill.
+    preempt = PreemptionHandler()
+    last_it = start_step - 1
+    with preempt:
+        for it in range(train_cfg.iters):
+            host_batch = next(batches).reshape(
+                n_data * train_cfg.batch_size, train_cfg.seq_len)
+            if it < start_step:
+                continue  # resume: replay the stream, preserving data order
+            if preempt.requested:
+                # Force-save a resumable checkpoint BEFORE dying: the next
+                # invocation restores step ``it`` and replays the stream.
+                # A checkpoint of THIS run's lineage at ``it`` exists only
+                # if this loop saved it (last_saved) or resumed from it
+                # (start_step); any other on-disk step ``it`` is a stale —
+                # possibly the corrupt — remnant of a pre-fallback lineage
+                # that the save must replace, not trust (latest_step() alone
+                # can't tell these apart after a corrupt-latest fallback).
+                if ckpt is not None:
+                    if it not in (last_saved, start_step):
+                        ckpt.save(it, state, force=True, overwrite=True)
+                    ckpt.wait()
+                report.preempted = True
+                report.resilience.preemptions += 1
+                log_fn(f"preempted at iter {it}: checkpoint "
+                       f"{'force-saved' if ckpt is not None else 'not saved'}"
+                       f"{'' if ckpt is not None else ' (no checkpoint dir)'}")
+                break
+            last_it = it
+            state, loss = step_fn(state, shard_fn(host_batch))
+            if it + 1 == start_step + warmup_steps_excluded:
+                float(loss)  # hard sync before starting the timer
+                t_start = time.perf_counter()
+            device_losses.append(loss)
+            if loss_sink is not None and (it % sink_every == 0
+                                          or it == train_cfg.iters - 1):
+                loss_sink(it, float(loss))
+            if log_every and it % log_every == 0:
+                log_fn(f"iter {it}: loss {float(loss):.4f}")
+            if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                try:
+                    # overwrite: after a corrupt-latest fallback resume the
+                    # loop re-treads step indices the dead lineage already
+                    # wrote (start_step < it+1 <= old latest), and those
+                    # stale entries must not survive as restore candidates.
+                    ckpt.save(it + 1, state, overwrite=True)
+                    last_saved = it + 1
+                except Exception as e:
+                    log_fn(f"periodic checkpoint at {it + 1} failed after "
+                           f"retries ({type(e).__name__}: {e}); continuing")
     if ckpt is not None:
-        if train_cfg.iters != last_saved:
-            ckpt.save(train_cfg.iters, state, force=True)
+        if not report.preempted and train_cfg.iters != last_saved:
+            ckpt.save(train_cfg.iters, state, force=True, overwrite=True)
         ckpt.close()
     report.losses = [float(l) for l in device_losses]  # syncs the chain
-    report.steps = train_cfg.iters - start_step
+    report.steps = (last_it + 1 if report.preempted else train_cfg.iters) \
+        - start_step
     if t_start is not None and report.steps > warmup_steps_excluded:
         report.wall_time = time.perf_counter() - t_start
         timed = report.steps - warmup_steps_excluded
         report.tokens_per_sec = tokens_per_step * timed / report.wall_time
     return report
+
+
+def _apply_resilience(step_fn, resilience: Optional[ResilienceConfig],
+                      fault_plan, ckpt, stats: ResilienceStats):
+    """Compose the resilience layer around a trainer's step function:
+    fault injection innermost (so the guard sees the faulted step — the two
+    halves test each other), StepGuard outermost. ``fault_plan`` may come in
+    as an object (tests) or via ``resilience.faults`` (CLI/config); fault
+    step indices are post-resume call indices."""
+    if fault_plan is None and resilience is not None and resilience.faults:
+        fault_plan = resilience.fault_plan()
+    if fault_plan:
+        step_fn = fault_plan.wrap_step(step_fn)
+    if resilience is not None and resilience.guard:
+        from ..resilience.guard import StepGuard
+        step_fn = StepGuard(
+            step_fn, ckpt=ckpt, stats=stats,
+            max_consecutive_bad=resilience.max_consecutive_bad,
+            ema_decay=resilience.ema_decay,
+            anomaly_factor=resilience.anomaly_factor,
+            ema_warmup=resilience.ema_warmup)
+    return step_fn
 
 
 def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
@@ -175,7 +274,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1000,
                  loss_sink: Optional[Callable[[int, float], None]] = None,
-                 sink_every: int = 10) -> LLMTrainReport:
+                 sink_every: int = 10,
+                 resilience: Optional[ResilienceConfig] = None,
+                 fault_plan=None) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
     ``aggregation``: "gradient" (allreduce grads — intro_DP_GA) or "weight"
@@ -187,11 +288,19 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     time dwarfs it, e.g. the oversubscribed virtual-CPU mesh).
 
     ``checkpoint_dir`` enables orbax checkpoint/resume (the persistence layer
-    the reference lacks, SURVEY.md §5.4): the latest step in the directory is
-    restored into the mesh layout before training, a checkpoint is written
-    every ``checkpoint_every`` steps and at the end, and already-completed
+    the reference lacks, SURVEY.md §5.4): the newest VALID step in the
+    directory is restored into the mesh layout before training (a corrupt
+    latest step falls back — checkpoint.py), a checkpoint is written every
+    ``checkpoint_every`` steps and at the end, and already-completed
     iterations are skipped — re-running the same call after an interruption
-    continues where it stopped.
+    continues where it stopped. SIGTERM mid-loop force-saves a resumable
+    checkpoint and returns with ``report.preempted=True``.
+
+    ``resilience`` (config.ResilienceConfig) wraps the step in a StepGuard
+    (skip non-finite steps, EMA spike detection, rollback after K
+    consecutive bad steps) and carries the checkpoint-IO retry budget.
+    ``fault_plan`` (resilience.FaultPlan) injects deterministic faults for
+    tests/chaos runs; counters come back in ``report.resilience``.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
@@ -237,10 +346,13 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             raise ValueError("accum_steps needs gradient aggregation")
         step_fn = dp.make_weight_aggregation_step(loss_fn, optimizer, mesh)
 
+    stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
-        checkpoint_dir, state, train_cfg.iters, log_fn)
+        checkpoint_dir, state, train_cfg.iters, log_fn,
+        resilience=resilience, stats=stats)
     if done:
-        return LLMTrainReport()
+        return LLMTrainReport(resilience=stats)
+    step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     # Disjoint stream windows per data shard — the reference's skip=rank*5000.
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len, n_data,
@@ -251,7 +363,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      checkpoint_every=checkpoint_every, loss_sink=loss_sink,
                      sink_every=sink_every, log_every=log_every,
                      log_fn=log_fn,
-                     warmup_steps_excluded=warmup_steps_excluded)
+                     warmup_steps_excluded=warmup_steps_excluded,
+                     stats=stats)
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
@@ -265,7 +378,9 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1000,
                  loss_sink: Optional[Callable[[int, float], None]] = None,
-                 sink_every: int = 10) -> LLMTrainReport:
+                 sink_every: int = 10,
+                 resilience: Optional[ResilienceConfig] = None,
+                 fault_plan=None) -> LLMTrainReport:
     """Pipeline(-x-data)-parallel tiny-Llama training; returns losses and
     throughput.
 
@@ -305,10 +420,13 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                                     n_microbatches=train_cfg.microbatches,
                                     schedule=schedule)
 
+    stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
-        checkpoint_dir, state, train_cfg.iters, log_fn)
+        checkpoint_dir, state, train_cfg.iters, log_fn,
+        resilience=resilience, stats=stats)
     if done:
-        return LLMTrainReport()
+        return LLMTrainReport(resilience=stats)
+    step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
                               n_data, shard_skip=5000, seed=train_cfg.seed)
@@ -318,4 +436,5 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                      checkpoint_every=checkpoint_every, loss_sink=loss_sink,
                      sink_every=sink_every, log_every=log_every,
                      log_fn=log_fn,
-                     warmup_steps_excluded=warmup_steps_excluded)
+                     warmup_steps_excluded=warmup_steps_excluded,
+                     stats=stats)

@@ -44,13 +44,68 @@ COLLECTIVE_TIMEOUT_FLAGS = (
     " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
 
 
+def collective_timeout_flags() -> str:
+    """COLLECTIVE_TIMEOUT_FLAGS iff this jaxlib's XLA accepts them, else "".
+
+    XLA *aborts the process* (parse_flags_from_env.cc) on any unknown flag in
+    XLA_FLAGS, at the first backend creation — so on a jaxlib build where
+    these flags were renamed/removed, passing them unconditionally kills
+    every test and experiment at startup instead of hardening them. Probe
+    once per jaxlib version in a subprocess (the only way to observe an
+    abort-on-parse) and cache the verdict in the temp dir.
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    try:
+        import jaxlib
+        ver = jaxlib.__version__
+    except Exception:
+        ver = "unknown"
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"ddl25_xla_flagprobe_{ver}")
+    try:
+        with open(cache) as f:
+            return COLLECTIVE_TIMEOUT_FLAGS if f.read().strip() == "1" else ""
+    except OSError:
+        pass
+    env = dict(os.environ,
+               XLA_FLAGS=COLLECTIVE_TIMEOUT_FLAGS.strip(),
+               JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms','cpu'); "
+             "jax.devices()"],
+            env=env, capture_output=True, timeout=120)
+        ok = proc.returncode == 0
+    except Exception:
+        # Transient probe failure (timeout under load, fork pressure): skip
+        # the flags for THIS run but do not cache the verdict — only a
+        # definitive rejection proves the jaxlib refuses them.
+        return ""
+    if not ok and b"flag" not in (proc.stderr + proc.stdout).lower():
+        # Nonzero exit that never mentions a flag (OOM kill, MemoryError
+        # during jax import, half-installed package) is transient, not a
+        # rejection — XLA's parse_flags abort always names the unknown flag.
+        # Don't poison the per-jaxlib cache with it.
+        return ""
+    try:
+        with open(cache, "w") as f:
+            f.write("1" if ok else "0")
+    except OSError:
+        pass
+    return COLLECTIVE_TIMEOUT_FLAGS if ok else ""
+
+
 def pin_cpu_virtual(n_devices: int = 8) -> None:
     os.environ.setdefault("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
         os.environ["XLA_FLAGS"] += \
             f" --xla_force_host_platform_device_count={n_devices}"
     if "collective" not in os.environ["XLA_FLAGS"]:
-        os.environ["XLA_FLAGS"] += COLLECTIVE_TIMEOUT_FLAGS
+        os.environ["XLA_FLAGS"] += collective_timeout_flags()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -34,7 +34,8 @@ CONFIGS = {
 
 
 def _run_config(name: str, iters: int, sink, provenance: str,
-                checkpoint_dir: str = None) -> Dict[str, float]:
+                checkpoint_dir: str = None, faults: str = "",
+                fault_seed: int = 0, guard: bool = False) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
@@ -56,13 +57,26 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                   loss_sink=lambda it, loss: sink.write(
                       {"iter": it, "loss": loss, "data": provenance,
                        "config": label}))
+    if faults or guard:
+        # Chaos/guarded runs (resilience layer): inject the scheduled faults
+        # and/or wrap the step in a StepGuard; counters print at the end so
+        # the run's survival is attributable, not anecdotal.
+        from ddl25spring_tpu.config import ResilienceConfig
+        kw["resilience"] = ResilienceConfig(guard=guard, faults=faults,
+                                            fault_seed=fault_seed)
     if topo["stage"] > 1:
         report = train_llm_pp(model_cfg, train_cfg, log_every=log_every, **kw)
     else:
         report = train_llm_dp(model_cfg, train_cfg, log_every=log_every, **kw)
+    if report.resilience is not None and (faults or guard):
+        print(f"{name}: resilience counters "
+              f"{ {k: v for k, v in report.resilience.as_dict().items() if v} }",
+              flush=True)
     if not report.losses:
         return {}  # resumed past the end; nothing new to record
-    base = iters - len(report.losses)  # resume offset (0 for a fresh run)
+    # Resume offset (0 for a fresh run). NOT iters - len(losses): a
+    # preempted run's losses end at the preempt step, not at iters.
+    base = report.start_step
     if checkpoint_dir is None:  # sink mode already wrote its rows
         for it in range(0, len(report.losses), 10):
             sink.write({"iter": base + it, "loss": report.losses[it],
@@ -71,8 +85,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                     "loss": report.losses[-1],
                     "data": provenance, "config": label})
     print(f"{name}: loss {report.losses[0]:.3f} -> {report.losses[-1]:.3f} "
-          f"over iters {base}..{iters} ({report.tokens_per_sec:.0f} tok/s) "
-          f"[{provenance}]", flush=True)
+          f"over iters {base}..{base + len(report.losses)} "
+          f"({report.tokens_per_sec:.0f} tok/s) [{provenance}]", flush=True)
     return {f"{name}_first": report.losses[0],
             f"{name}_last": report.losses[-1],
             f"{name}_tokens_per_sec": report.tokens_per_sec}
@@ -80,7 +94,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
 
 def main(quick: bool = False, iters: int = 5000,
          configs=("dp1",), append: bool = False,
-         checkpoint_dir: str = None) -> Dict[str, float]:
+         checkpoint_dir: str = None, faults: str = "",
+         fault_seed: int = 0, guard: bool = False) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -105,7 +120,8 @@ def main(quick: bool = False, iters: int = 5000,
     out: Dict[str, float] = {}
     for name in configs:
         out.update(_run_config(name, iters, sink, provenance,
-                               checkpoint_dir=checkpoint_dir))
+                               checkpoint_dir=checkpoint_dir, faults=faults,
+                               fault_seed=fault_seed, guard=guard))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -130,6 +146,14 @@ if __name__ == "__main__":
                     help="orbax checkpoint/resume dir — lets a watchdog "
                          "kill and relaunch a wedged virtual-mesh run "
                          "without losing progress (saves every 50 iters)")
+    ap.add_argument("--faults", default="",
+                    help="resilience FaultPlan spec, e.g. "
+                         "'nan_grad@10,preempt@25' (implies --guard makes "
+                         "sense; see ddl25spring_tpu/resilience/faults.py)")
+    ap.add_argument("--fault-seed", type=int, default=0)
+    ap.add_argument("--guard", action="store_true",
+                    help="wrap the train step in a StepGuard (skip "
+                         "non-finite steps, EMA spike detection, rollback)")
     a = ap.parse_args()
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual
@@ -140,4 +164,5 @@ if __name__ == "__main__":
         # --checkpoint-dir so a killed run resumes.
         pin_cpu_virtual()
     main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append,
-         checkpoint_dir=a.checkpoint_dir)
+         checkpoint_dir=a.checkpoint_dir, faults=a.faults,
+         fault_seed=a.fault_seed, guard=a.guard)

@@ -10,6 +10,14 @@ process and relaunch — the run resumes from its orbax checkpoint and
 re-streams only the lost tail. On success, duplicate rows from retried
 segments are deduped in place.
 
+Relaunches back off exponentially with deterministic jitter
+(ddl25spring_tpu/resilience/retry.py), and crash-loops are distinguished
+from stalls: a process that exits nonzero within ``--crash-window`` seconds
+is crashing, not wedging — after ``--crash-loop-limit`` consecutive crashes
+the watchdog exits with code 3 instead of burning all ``--max-restarts``
+against a broken command. Exit codes: 0 success, 1 gave up on stalls/slow
+failures, 3 crash loop.
+
 Example (the b2-topology loss curve):
     python -m experiments.watchdog \
         --progress experiments/results/hw1b_llm_loss.csv \
@@ -34,6 +42,10 @@ def file_size(path: str) -> int:
         return -1
 
 
+EXIT_GAVE_UP = 1      # burned --max-restarts on stalls/slow failures
+EXIT_CRASH_LOOP = 3   # consecutive immediate exits: relaunching won't help
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--progress", required=True,
@@ -42,6 +54,16 @@ def main() -> int:
                     help="kill+relaunch after this many minutes without "
                          "progress-file growth")
     ap.add_argument("--max-restarts", type=int, default=30)
+    ap.add_argument("--backoff-base", type=float, default=5.0,
+                    help="seconds before the first relaunch; doubles per "
+                         "consecutive failure (jittered, capped 120 s)")
+    ap.add_argument("--crash-window", type=float, default=5.0,
+                    help="a nonzero exit within this many seconds of launch "
+                         "counts as a crash, not a stall")
+    ap.add_argument("--crash-loop-limit", type=int, default=3,
+                    help="this many consecutive crashes -> exit "
+                         f"{EXIT_CRASH_LOOP} (crash loop: the command is "
+                         "broken, relaunching won't help)")
     ap.add_argument("--dedupe-keys", nargs="*", default=None,
                     help="CSV columns identifying a row; dedupe the "
                          "progress file on success")
@@ -52,12 +74,22 @@ def main() -> int:
     if not cmd:
         ap.error("no command given after --")
 
+    from ddl25spring_tpu.resilience.retry import backoff_schedule
+
+    # Deterministic jittered relaunch delays (resilience/retry.py) — a
+    # crashing command no longer burns all --max-restarts in seconds.
+    delays = backoff_schedule(a.max_restarts, base=a.backoff_base,
+                              max_delay=120.0, seed=0)
     poll_s = 30.0
+    consecutive_crashes = 0
+    consecutive_failures = 0  # resets when a segment makes progress
     for attempt in range(a.max_restarts + 1):
         print(f"[watchdog] attempt {attempt}: {' '.join(cmd)}", flush=True)
+        launched = time.time()
         proc = subprocess.Popen(cmd)
         last_size = file_size(a.progress)
         last_change = time.time()
+        progressed = False
         while True:
             try:
                 rc = proc.wait(timeout=poll_s)
@@ -67,6 +99,7 @@ def main() -> int:
             size = file_size(a.progress)
             if size != last_size:
                 last_size, last_change = size, time.time()
+                progressed = True
             elif time.time() - last_change > a.stall_min * 60:
                 print(f"[watchdog] no growth of {a.progress} for "
                       f"{a.stall_min} min — killing pid {proc.pid}",
@@ -85,10 +118,37 @@ def main() -> int:
                 print("[watchdog] done", flush=True)
             return 0
         if rc is not None:
-            print(f"[watchdog] command exited rc={rc}; retrying from "
-                  f"checkpoint", flush=True)
+            elapsed = time.time() - launched
+            if elapsed < a.crash_window:
+                # Immediate exit: an import error, bad flag, or missing file
+                # — a different failure class from the stalls this tool
+                # exists for, and one a relaunch cannot fix.
+                consecutive_crashes += 1
+                print(f"[watchdog] command CRASHED rc={rc} after "
+                      f"{elapsed:.1f}s ({consecutive_crashes}/"
+                      f"{a.crash_loop_limit})", flush=True)
+                if consecutive_crashes >= a.crash_loop_limit:
+                    print("[watchdog] crash loop — the command fails "
+                          "immediately; fix it instead of relaunching",
+                          file=sys.stderr)
+                    return EXIT_CRASH_LOOP
+            else:
+                consecutive_crashes = 0
+                print(f"[watchdog] command exited rc={rc}; retrying from "
+                      f"checkpoint", flush=True)
+        else:
+            consecutive_crashes = 0  # a stall kill is not a crash
+        # Backoff doubles per CONSECUTIVE failure: a segment that grew the
+        # progress file resets the ladder, so a stall after hours of healthy
+        # training relaunches at --backoff-base, not at the cap.
+        consecutive_failures = 1 if progressed else consecutive_failures + 1
+        if attempt < a.max_restarts:
+            delay = delays[min(consecutive_failures - 1, len(delays) - 1)]
+            print(f"[watchdog] backing off {delay:.1f}s before relaunch",
+                  flush=True)
+            time.sleep(delay)
     print("[watchdog] gave up after max restarts", file=sys.stderr)
-    return 1
+    return EXIT_GAVE_UP
 
 
 if __name__ == "__main__":

@@ -10,14 +10,16 @@ The env vars MUST be set before jax is imported anywhere.
 
 import os
 
-from experiments._cpu_pin import COLLECTIVE_TIMEOUT_FLAGS
+from experiments._cpu_pin import collective_timeout_flags
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 if "collective" not in os.environ["XLA_FLAGS"]:
     # Oversubscribed-core hardening — rationale in experiments/_cpu_pin.py.
-    os.environ["XLA_FLAGS"] += COLLECTIVE_TIMEOUT_FLAGS
+    # Probed, not unconditional: on jaxlib builds that don't know these
+    # flags XLA aborts the whole test process at backend creation.
+    os.environ["XLA_FLAGS"] += collective_timeout_flags()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -29,6 +31,11 @@ jax.config.update("jax_platforms", "cpu")
 # Serialize dispatch: overlapped steps' collectives can deadlock the virtual
 # CPU mesh (failure mode 2 in experiments/_cpu_pin.py).
 jax.config.update("jax_cpu_enable_async_dispatch", False)
+# NOTE: do NOT enable the persistent XLA compilation cache
+# (jax_compilation_cache_dir) here: on this jaxlib (0.4.36) a cached
+# executable with donated input buffers segfaults the whole test process
+# when reloaded on the CPU backend (reproduced in the trainer-resume tests).
+# The ~28% warm-cache wall-time win is not worth a crashing suite.
 
 
 @pytest.fixture(scope="session")

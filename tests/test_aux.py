@@ -159,14 +159,14 @@ def test_initialize_is_noop_without_rendezvous_config(monkeypatch):
     for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
         monkeypatch.delenv(var, raising=False)
     distributed.initialize()
-    assert not jax.distributed.is_initialized()
+    assert not distributed._is_initialized()
 
 
 def test_initialize_short_circuits_when_already_initialized(monkeypatch):
     """If the rendezvous already happened, initialize() must not re-read env
     vars or re-initialize (idempotence across entry points)."""
     calls = []
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(distributed, "_is_initialized", lambda: True)
     monkeypatch.setattr(jax.distributed, "initialize",
                         lambda **kw: calls.append(kw))
     distributed.initialize(coordinator_address="203.0.113.1:1234",
@@ -178,7 +178,7 @@ def test_initialize_forwards_rendezvous_args(monkeypatch):
     """Explicit args (or env vars) reach jax.distributed.initialize — the
     MASTER_ADDR/MASTER_PORT convention without per-rank processes."""
     calls = []
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(distributed, "_is_initialized", lambda: False)
     monkeypatch.setattr(jax.distributed, "initialize",
                         lambda **kw: calls.append(kw))
     distributed.initialize(coordinator_address="203.0.113.1:1234",

@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 from ddl25spring_tpu.config import LlamaConfig
 from ddl25spring_tpu.models import llama
 from ddl25spring_tpu.ops import causal_lm_loss
+from ddl25spring_tpu.parallel._compat import shard_map
 from ddl25spring_tpu.parallel import make_mesh, sp
 
 
@@ -31,7 +32,7 @@ def test_ring_attention_matches_full():
     k = jax.random.normal(kk, (b, t, h, dh), jnp.float32)
     v = jax.random.normal(kv, (b, t, h, dh), jnp.float32)
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda q, k, v: sp.ring_attention(q, k, v, "seq", causal=True),
         mesh=mesh, in_specs=P(None, "seq"), out_specs=P(None, "seq"),
         check_vma=False))

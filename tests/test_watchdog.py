@@ -50,3 +50,42 @@ def test_watchdog_kills_stall_and_resumes(tmp_path):
     rows = prog.read_text().strip().splitlines()
     iters = [int(r.split(",")[0]) for r in rows[1:]]
     assert iters == list(range(20)), iters       # contiguous after resume
+
+
+CRASHER = "import sys; sys.exit(2)"
+
+
+def test_watchdog_crash_loop_exits_distinct_code(tmp_path):
+    """A command that dies instantly is a crash loop, not a stall: the
+    watchdog must stop after --crash-loop-limit consecutive crashes with
+    exit code 3 instead of burning all --max-restarts."""
+    prog = tmp_path / "progress.csv"
+    proc = subprocess.run(
+        [sys.executable, "-m", "experiments.watchdog",
+         "--progress", str(prog), "--stall-min", "0.02",
+         "--max-restarts", "20", "--backoff-base", "0.05",
+         "--crash-window", "30", "--crash-loop-limit", "3", "--",
+         sys.executable, "-c", CRASHER],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 3, (proc.returncode, proc.stdout, proc.stderr)
+    assert proc.stdout.count("CRASHED") == 3      # stopped at the limit...
+    assert "attempt 3" not in proc.stdout         # ...not at max-restarts
+    assert "crash loop" in proc.stderr
+
+
+def test_watchdog_backoff_between_relaunches(tmp_path):
+    """Consecutive failures back off (exponentially, jittered): the second
+    relaunch waits longer than the first."""
+    import re
+    prog = tmp_path / "progress.csv"
+    proc = subprocess.run(
+        [sys.executable, "-m", "experiments.watchdog",
+         "--progress", str(prog), "--stall-min", "0.02",
+         "--max-restarts", "2", "--backoff-base", "0.1",
+         "--crash-window", "30", "--crash-loop-limit", "99", "--",
+         sys.executable, "-c", CRASHER],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1                   # gave up, not crash-looped
+    delays = [float(m) for m in re.findall(r"backing off ([0-9.]+)s",
+                                           proc.stdout)]
+    assert len(delays) == 2 and delays[1] > delays[0]
