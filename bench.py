@@ -136,38 +136,55 @@ def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                bf16_params=bf16_params, kv_dtype=kv_dtype)
 
 
-def _guard_overhead(mesh, base_cfg: LlamaConfig):
-    """(guard_overhead_pct, counters) for the headline JSON: the measured
-    fault-free cost of StepGuard around the DP train step. Canonical config
-    on an accelerator; a reduced config on the CPU fallback (the ratio is
-    what matters, and the canonical model at CPU speed would double the
-    bench's wall time). Never sinks the bench: failures report null."""
+def _reduced_dp_setup(mesh, base_cfg: LlamaConfig, **overrides):
+    """Shared probe setup for _guard_overhead and _telemetry_block, so both
+    measure the SAME program family: the canonical config on an
+    accelerator, a reduced one on the CPU fallback (the canonical model at
+    CPU speed would double the bench's wall time), and a builder for the
+    replicated DP state + grad-aggregation step. ``overrides`` apply on
+    BOTH platforms — a caller that needs a normalization (e.g.
+    _telemetry_block's dtype="float32") needs it regardless of where the
+    probe runs."""
     import dataclasses
 
     import optax
 
     from ddl25spring_tpu.models import llama
     from ddl25spring_tpu.parallel import dp
+
+    if PLATFORM in (None, "cpu"):
+        cfg = dataclasses.replace(
+            base_cfg, vocab_size=2048, dmodel=64, num_heads=2,
+            n_layers=2, ctx_size=64, attention_impl="xla", **overrides)
+        batch_size = 4
+    else:
+        cfg = (dataclasses.replace(base_cfg, **overrides) if overrides
+               else base_cfg)
+        batch_size = 32
+
+    def make():
+        params = llama.init_llama(jax.random.key(0), cfg)
+        opt = optax.adam(8e-4)
+        state = dp.replicate(mesh, dp.init_state(params, opt))
+        step = dp.make_grad_aggregation_step(
+            lambda p, b: llama.forward_loss(p, b, cfg), opt, mesh)
+        return state, step
+
+    return cfg, batch_size, make
+
+
+def _guard_overhead(mesh, base_cfg: LlamaConfig):
+    """(guard_overhead_pct, counters) for the headline JSON: the measured
+    fault-free cost of StepGuard around the DP train step (reduced config
+    on the CPU fallback — the ratio is what matters). Never sinks the
+    bench: failures report null."""
+    from ddl25spring_tpu.parallel import dp
     from ddl25spring_tpu.resilience.guard import measure_overhead
 
     try:
-        if PLATFORM in (None, "cpu"):
-            cfg = dataclasses.replace(
-                base_cfg, vocab_size=2048, dmodel=64, num_heads=2,
-                n_layers=2, ctx_size=64, attention_impl="xla")
-            batch_size, steps = 4, 8
-        else:
-            cfg, batch_size, steps = base_cfg, 32, 20
+        cfg, batch_size, make = _reduced_dp_setup(mesh, base_cfg)
+        steps = 8 if PLATFORM in (None, "cpu") else 20
         n_dev = mesh.devices.size
-
-        def make():
-            params = llama.init_llama(jax.random.key(0), cfg)
-            opt = optax.adam(8e-4)
-            state = dp.replicate(mesh, dp.init_state(params, opt))
-            step = dp.make_grad_aggregation_step(
-                lambda p, b: llama.forward_loss(p, b, cfg), opt, mesh)
-            return state, step
-
         tokens = jax.random.randint(
             jax.random.key(1), (n_dev * batch_size, cfg.ctx_size),
             0, cfg.vocab_size)
@@ -178,6 +195,59 @@ def _guard_overhead(mesh, base_cfg: LlamaConfig):
         print(f"guard-overhead measurement failed ({type(e).__name__}: {e})",
               file=sys.stderr)
         return None, None
+
+
+def _telemetry_block(mesh, base_cfg: LlamaConfig):
+    """Telemetry block for the headline JSON (telemetry/{comm,costs}.py):
+    the DP step's static per-collective byte profile and the compiled
+    program's own FLOP count cross-checking ``train_step_flops_per_token``.
+
+    Returns ``(block, flops_source)``. ``flops_source`` is "hlo" only when
+    XLA's count for the measured program agrees with the analytic formula
+    within 10%; otherwise "analytic" — and the caller warns, because either
+    the formula or the lowering changed. Known cause on this jaxlib
+    (0.4.36): cost_analysis counts a ``lax.scan`` body ONCE, not × trip
+    count, so the scanned layer stack undercounts and the crosscheck
+    reports the divergence rather than hiding it. Same isolation contract
+    as _guard_overhead: reduced config on the CPU fallback, never sinks
+    the bench."""
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.telemetry import (flops_crosscheck, hlo_cost,
+                                           measure_comm)
+
+    try:
+        # float32 for the crosscheck probe on EVERY platform: XLA's cost
+        # model counts bf16 casts as ops, muddying the FLOP comparison
+        # against the analytic formula (which is dtype-blind).
+        cfg, batch_size, make = _reduced_dp_setup(mesh, base_cfg,
+                                                  dtype="float32")
+        seq = cfg.ctx_size
+        n_dev = mesh.devices.size
+        state, step = make()
+        batch_sds = jax.ShapeDtypeStruct((n_dev * batch_size, seq), jnp.int32)
+        profile = measure_comm(step, state, batch_sds)
+        hlo = hlo_cost(step, state, batch_sds)
+        # cost_analysis covers ONE partition's module: compare against the
+        # analytic count for one device's token share.
+        local_tokens = batch_size * seq
+        analytic = train_step_flops_per_token(cfg, seq) * local_tokens
+        check = flops_crosscheck(analytic, hlo)
+        block = {
+            "comm": profile.as_dict() if profile is not None else None,
+            "hlo_flops_per_token": (hlo["flops"] / local_tokens
+                                    if hlo is not None else None),
+            "hlo_bytes_accessed": (hlo or {}).get("bytes_accessed"),
+            "flops_rel_err": (round(check["rel_err"], 4)
+                              if check["rel_err"] is not None else None),
+            "cross_checked_cfg": ("reduced" if PLATFORM in (None, "cpu")
+                                  else "canonical"),
+        }
+        return block, check["flops_source"]
+    except Exception as e:
+        print(f"telemetry block failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return None, "analytic"
 
 
 def main():
@@ -280,6 +350,16 @@ def main():
     mfu = (None if PLATFORM in (None, "cpu")
            else round(per_chip * flops_tok / peak_flops_per_chip(), 4))
     guard_overhead, guard_stats = _guard_overhead(mesh, base)
+    telemetry_block, flops_source = _telemetry_block(mesh, base)
+    if flops_source == "analytic":
+        # Either cost_analysis is unavailable on this jaxlib or its count
+        # diverges >10% from the formula — the headline MFU then rests on
+        # the analytic number alone, and that caveat belongs on stderr.
+        rel = (telemetry_block or {}).get("flops_rel_err")
+        print("flops cross-check: using analytic formula "
+              + (f"(HLO diverges {rel:.0%} — scan bodies count once "
+                 "on this jaxlib)" if rel is not None
+                 else "(HLO cost_analysis unavailable)"), file=sys.stderr)
     print(json.dumps({
         "metric": "tiny_llama_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -296,6 +376,12 @@ def main():
         # the overhead number is a fault-free measurement.
         "guard_overhead_pct": guard_overhead,
         "resilience": guard_stats,
+        # Telemetry layer (ddl25spring_tpu/telemetry): static comm profile
+        # of the DP step and XLA's own FLOP count for the compiled program.
+        # flops_source says which count backs the MFU figure above —
+        # "hlo" means the compiler corroborated the analytic formula.
+        "flops_source": flops_source,
+        "telemetry": telemetry_block,
     }))
 
     # Decode throughput (KV-cache path, models/generate.py) — a stderr

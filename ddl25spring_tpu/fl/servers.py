@@ -67,7 +67,7 @@ class _ServerBase:
 
     def __init__(self, init_params: PyTree, apply_fn, data: FederatedDataset,
                  test_x: jnp.ndarray, test_y: jnp.ndarray, cfg: FLConfig,
-                 algorithm: str, fault_plan=None):
+                 algorithm: str, fault_plan=None, telemetry=None):
         self.apply_fn = apply_fn
         self.params = init_params
         self.data = data
@@ -77,6 +77,10 @@ class _ServerBase:
         # Benign-fault injection (resilience.FaultPlan): scheduled client
         # dropout/straggling per round. Counters in ``self.resilience``.
         self.fault_plan = fault_plan
+        # Unified observability (telemetry.Telemetry): ``run`` emits a
+        # manifest, one fl_round summary per round, a per-round heartbeat,
+        # and a run_end metrics snapshot into the shared event stream.
+        self.telemetry = telemetry
         self.resilience = ResilienceStats()
         self.result = RunResult(algorithm, cfg.nr_clients, cfg.client_fraction,
                                 cfg.batch_size, cfg.epochs, cfg.lr, cfg.seed)
@@ -144,11 +148,37 @@ class _ServerBase:
 
     def run(self, nr_rounds: Optional[int] = None) -> RunResult:
         nr_rounds = self.cfg.rounds if nr_rounds is None else nr_rounds
+        tel = self.telemetry
+        if tel is not None:
+            import dataclasses
+            tel.events.manifest(
+                trainer=f"fl/{self.result.algorithm}",
+                jax_version=jax.__version__,
+                platform=jax.devices()[0].platform,
+                fl_cfg=dataclasses.asdict(self.cfg), rounds=nr_rounds)
+            prev_counters = self.resilience.as_dict()
         for r in range(nr_rounds):
             t0 = time.perf_counter()
             self.params = self._round(self.params, r)
             jax.block_until_ready(self.params)
             self._record(r, time.perf_counter() - t0)
+            if tel is not None:
+                tel.heartbeat.beat(step=r, phase="fl_round")
+                wall = self.result.wall_time[-1]
+                tel.registry.observe("fl_round_s", wall)
+                delta = self.resilience.delta(prev_counters)
+                prev_counters = self.resilience.as_dict()
+                tel.events.fl_round(
+                    round=r, wall_s=wall,
+                    test_accuracy=self.result.test_accuracy[-1],
+                    messages=self.result.message_count[-1],
+                    **({"faults": delta} if delta else {}))
+        if tel is not None:
+            tel.registry.absorb_resilience(self.resilience)
+            tel.events.run_end(steps=nr_rounds,
+                               final_accuracy=(self.result.test_accuracy[-1]
+                                               if self.result.rounds else None),
+                               metrics=tel.registry.snapshot())
         return self.result
 
 
@@ -287,12 +317,13 @@ class CentralizedServer(_ServerBase):
     """Non-federated baseline: plain minibatch SGD over the whole training
     set, one epoch per round (hfl_complete.py:184-223)."""
 
-    def __init__(self, init_params, apply_fn, x, y, test_x, test_y, cfg: FLConfig):
+    def __init__(self, init_params, apply_fn, x, y, test_x, test_y, cfg: FLConfig,
+                 telemetry=None):
         x, y = jnp.asarray(x), jnp.asarray(y)
         data = FederatedDataset(x[None], y[None], jnp.ones(y.shape, jnp.float32)[None],
                                 jnp.asarray([y.shape[0]]))
         super().__init__(init_params, apply_fn, data, test_x, test_y, cfg,
-                         algorithm="centralized")
+                         algorithm="centralized", telemetry=telemetry)
         # The baseline is one node: N=1, C=1, E=1, and zero messages per
         # round (reference: hfl_complete.py:205 appends message_count 0).
         self.result = RunResult("centralized", 1, 1.0, cfg.batch_size, 1,

@@ -87,6 +87,13 @@ class ResilienceStats:
             setattr(self, k, getattr(self, k) + v)
         return self
 
+    def delta(self, prev: dict) -> dict:
+        """Counters that moved since the ``prev`` snapshot (an ``as_dict``
+        result) — the shape telemetry fault events carry. Empty when
+        nothing changed."""
+        return {k: v - prev.get(k, 0) for k, v in self.as_dict().items()
+                if v != prev.get(k, 0)}
+
     @property
     def total_faults_handled(self) -> int:
         return sum(self.__dict__.values())

@@ -45,6 +45,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import comm
 from ._compat import shard_map
 
 from .dp import TrainState, apply_optimizer, init_state, replicate
@@ -54,7 +55,10 @@ def _pmean_bf16(grads, axis: str):
     """pmean with a bf16 wire format: the collective moves half the bytes;
     accumulation happens in the reduction's native precision."""
     down = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
-    summed = lax.pmean(down, axis)
+    # Recorded on the bf16 operand: telemetry.comm credits this collective
+    # with HALF the fp32 allreduce's payload — the whole point of the wire
+    # format, now visible in the comm profile.
+    summed = comm.pmean(down, axis, label="grad_allreduce_bf16")
     return jax.tree.map(lambda g, ref: g.astype(ref.dtype), summed, grads)
 
 
@@ -69,7 +73,7 @@ def make_bf16_grad_step(loss_fn: Callable,
     def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         grads = _pmean_bf16(grads, "data")
-        loss = lax.pmean(loss, "data")
+        loss = comm.pmean(loss, "data", label="loss_allreduce")
         params, opt_state = apply_optimizer(optimizer, grads,
                                             state.opt_state, state.params)
         return TrainState(params, opt_state, state.step + 1), loss
@@ -123,7 +127,7 @@ def make_int8_ef_grad_step(loss_fn: Callable,
 
     def local_step(state: EFTrainState, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        loss = lax.pmean(loss, "data")
+        loss = comm.pmean(loss, "data", label="loss_allreduce")
 
         flat_g, treedef = jax.tree.flatten(grads)
         res = jax.tree.leaves(state.residual)
@@ -132,8 +136,9 @@ def make_int8_ef_grad_step(loss_fn: Callable,
         # One collective for all scales: pmax of the [n_leaves] maxima.
         local_max = jnp.stack(
             [jnp.max(jnp.abs(c)).astype(jnp.float32) for c in c_leaves])
-        scales = jnp.maximum(lax.pmax(local_max, "data") / 127.0,
-                             jnp.finfo(jnp.float32).tiny)
+        scales = jnp.maximum(
+            comm.pmax(local_max, "data", label="int8_scale_pmax") / 127.0,
+            jnp.finfo(jnp.float32).tiny)
 
         q_leaves = [
             jnp.clip(jnp.round(c / scales[i].astype(c.dtype)),
@@ -143,7 +148,8 @@ def make_int8_ef_grad_step(loss_fn: Callable,
         # int8 vector (1 byte/element on the wire; a psum of quantized
         # values would up-cast the operand to int32 and save nothing).
         payload = jnp.concatenate([q.reshape(-1) for q in q_leaves])
-        gathered = lax.all_gather(payload, "data")        # [n, N] int8
+        gathered = comm.all_gather(payload, "data",
+                                   label="int8_grad_gather")  # [n, N] int8
         totals = jnp.sum(gathered.astype(jnp.int32), axis=0)
 
         g_avg_leaves, res_leaves = [], []

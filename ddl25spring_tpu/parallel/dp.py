@@ -24,6 +24,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import comm
 from ._compat import shard_map
 
 
@@ -131,8 +132,11 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
             grads = jax.tree.map(
                 lambda g, p: (g / accum_steps).astype(p.dtype),
                 gsum, state.params)
-        grads = lax.pmean(grads, "data")          # the one collective per iter
-        loss = lax.pmean(loss, "data")
+        # The one payload collective per iter (telemetry.comm wrappers are
+        # lax pass-throughs that record bytes at trace time — see
+        # telemetry/comm.py; compiled HLO is unchanged).
+        grads = comm.pmean(grads, "data", label="grad_allreduce")
+        loss = comm.pmean(loss, "data", label="loss_allreduce")
         params, opt_state = apply_optimizer(optimizer, grads,
                                             state.opt_state, state.params)
         if guard_nonfinite:
@@ -170,13 +174,13 @@ def make_weight_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTra
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        params = lax.pmean(params, "data")        # weight allreduce
+        params = comm.pmean(params, "data", label="weight_allreduce")
         # Average the optimizer moments too: the reference keeps per-process
         # Adam state, but an SPMD TrainState declared replicated must BE
         # replicated — divergent per-shard moments would silently collapse to
         # shard 0's on any reshard/checkpoint. Documented deviation.
-        opt_state = lax.pmean(opt_state, "data")
-        loss = lax.pmean(loss, "data")
+        opt_state = comm.pmean(opt_state, "data", label="optstate_allreduce")
+        loss = comm.pmean(loss, "data", label="loss_allreduce")
         return TrainState(params, opt_state, state.step + 1), loss
 
     sharded = shard_map(
@@ -252,20 +256,22 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         flat_g = jnp.pad(pt.flatten(grads)[0].astype(jnp.float32), (0, pad))
         # Averaged 1/n-th of the gradient lands on its owner shard.
-        g_mine = lax.psum_scatter(flat_g, "data", scatter_dimension=0,
-                                  tiled=True) / n
+        g_mine = comm.psum_scatter(flat_g, "data", scatter_dimension=0,
+                                   tiled=True,
+                                   label="zero1_grad_scatter") / n
         raw_flat, unravel = pt.flatten(params)
         flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
         shard = lax.axis_index("data")
         p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
         updates, opt_state = optimizer.update(g_mine, state.opt_state, p_mine)
         p_new = optax.apply_updates(p_mine, updates)
-        flat_new = lax.all_gather(p_new, "data", tiled=True)[:total]
+        flat_new = comm.all_gather(p_new, "data", tiled=True,
+                                   label="zero1_param_gather")[:total]
         # Cast back before unravel: for single-dtype trees ravel_pytree's
         # unravel is dtype-polymorphic and would silently rebuild non-fp32
         # params (e.g. param_dtype="bfloat16") as fp32.
         new_params = unravel(flat_new.astype(raw_flat.dtype))
-        loss = lax.pmean(loss, "data")
+        loss = comm.pmean(loss, "data", label="loss_allreduce")
         return TrainState(new_params, opt_state, state.step + 1), loss
 
     step = shard_map(

@@ -25,6 +25,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import comm
 from ._compat import shard_map
 
 from ..config import MoEConfig
@@ -79,17 +80,20 @@ def make_ep_train_step(cfg: MoEConfig, optimizer: optax.GradientTransformation,
 
     def sharded_grads(params: dict, tokens):
         loss, grads = jax.value_and_grad(_ep_loss)(params, tokens, cfg, ep)
+        def _replicated_psum(x):
+            return comm.psum(x, "expert", label="ep_replicated_grads")
+
         grads = {
             k: ({name: (g if name in _EXPERT_LEAVES else
-                        jax.tree.map(lambda x: lax.psum(x, "expert"), g))
+                        jax.tree.map(_replicated_psum, g))
                  for name, g in v.items()} if k == "blocks"
-                else jax.tree.map(lambda x: lax.psum(x, "expert"), v))
+                else jax.tree.map(_replicated_psum, v))
             for k, v in grads.items()
         }
         loss = loss * ep
         if has_data:
-            grads = lax.pmean(grads, "data")
-            loss = lax.pmean(loss, "data")
+            grads = comm.pmean(grads, "data", label="grad_allreduce")
+            loss = comm.pmean(loss, "data", label="loss_allreduce")
         return loss, grads
 
     def step(state: TrainState, tokens):

@@ -46,6 +46,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import comm
 from ._compat import shard_map
 
 from ..config import LlamaConfig
@@ -152,7 +153,11 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                 lambda: jnp.zeros((), jnp.float32))
             # The hop: activations ride the ICI ring to the next stage. The
             # last→first edge carries bubble garbage that stage 0 discards.
-            x_next = lax.ppermute(h, "stage", fwd)
+            # (scale=n_ticks: the scan body traces once, hops n_ticks times;
+            # the backward hops autodiff adds are telemetry/comm.py's
+            # documented under-count.)
+            x_next = comm.ppermute(h, "stage", fwd, label="pp_activation_hop",
+                                   scale=n_ticks)
             return (x_next, loss_sum + mb_loss), None
 
         x0 = jnp.zeros((mb, t, cfg.dmodel), jnp.dtype(cfg.dtype))
@@ -172,7 +177,8 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
 def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
     """Cross-stage/model/data reductions shared by both schedules."""
-    loss = lax.psum(loss, "stage") * tp  # broadcast + undo 1/tp for reporting
+    loss = comm.psum(loss, "stage",  # broadcast + undo 1/tp for reporting
+                     label="pp_loss_allreduce") * tp
 
     def reduce_grad(name, g):
         # Block weight matrices under TP are sharded over ``model`` — their
@@ -181,21 +187,25 @@ def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
         # (embed/head/final_norm) are also replicated over ``stage`` and got
         # grads only on the stage that read them: psum over ``stage`` too.
         if tp_axis is not None and name not in _TP_COL | _TP_ROW:
-            g = jax.tree.map(lambda x: lax.psum(x, tp_axis), g)
+            g = jax.tree.map(
+                lambda x: comm.psum(x, tp_axis,
+                                    label="tp_replicated_grads"), g)
         return g
 
     grads = {
         k: ({name: reduce_grad(name, g) for name, g in v.items()}
             if k == "blocks"
-            else jax.tree.map(lambda g: lax.psum(g, "stage"),
-                              reduce_grad(k, v)))
+            else jax.tree.map(
+                lambda g: comm.psum(g, "stage",
+                                    label="pp_replicated_grads"),
+                reduce_grad(k, v)))
         for k, v in grads.items()
     }
     if has_data_axis:
         # The DP×PP cross-pipeline sync — for ALL stages, not just stage 0
         # (the reference's [0,3]-only allreduce is a recorded bug).
-        grads = lax.pmean(grads, "data")
-        loss = lax.pmean(loss, "data")
+        grads = comm.pmean(grads, "data", label="grad_allreduce")
+        loss = comm.pmean(loss, "data", label="loss_allreduce")
     return loss, grads
 
 
@@ -344,7 +354,8 @@ def _pipeline_interleaved_loss_and_grad(params: dict, tokens: jnp.ndarray,
                 exit_here,
                 lambda: llama.head_loss(p, h, tok, cfg),
                 lambda: jnp.zeros((), jnp.float32))
-            x_next = lax.ppermute(h, "stage", fwd)
+            x_next = comm.ppermute(h, "stage", fwd, label="pp_activation_hop",
+                                   scale=n_ticks)
             return (x_next, loss_sum + mb_loss), None
 
         x0 = jnp.zeros((mb, t, cfg.dmodel), jnp.dtype(cfg.dtype))
@@ -421,7 +432,8 @@ def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaCo
         old = lax.dynamic_index_in_dim(stash, slot_f, keepdims=False)
         stash = lax.dynamic_update_index_in_dim(
             stash, jnp.where(valid_f, act_in, old), slot_f, axis=0)
-        x_fwd = lax.ppermute(h, "stage", fwd_perm)
+        x_fwd = comm.ppermute(h, "stage", fwd_perm,
+                              label="pp_activation_hop", scale=n_iters)
 
         # --- B sub-tick: vjp-recompute microbatch i_b from its stash ------
         i_b = j - 2 * (n_stages - 1) + stage
@@ -441,7 +453,8 @@ def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaCo
         dp, da = pull((g_h, g_loss.astype(jnp.float32)))
         grads = jax.tree.map(jnp.add, grads, dp)
         loss_sum = loss_sum + jnp.where(is_last & valid_b, mb_loss, 0.0)
-        g_bwd = lax.ppermute(da.astype(dt), "stage", bwd_perm)
+        g_bwd = comm.ppermute(da.astype(dt), "stage", bwd_perm,
+                              label="pp_cotangent_hop", scale=n_iters)
 
         return (stash, grads, loss_sum, x_fwd, g_bwd), None
 

@@ -45,6 +45,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import comm
 from ._compat import axis_size, shard_map
 
 from ..config import LlamaConfig
@@ -57,12 +58,19 @@ _NEG_INF = -1e30
 # --------------------------------------------------------------- the kernel
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str, *, causal: bool = True) -> jnp.ndarray:
+                   axis_name: str, *, causal: bool = True,
+                   comm_scale: int = 1) -> jnp.ndarray:
     """Ring attention over sequence shards. Must run inside shard_map.
 
     q, k, v: local shards [B, T_local, H, Dh] whose global positions are
     ``axis_index * T_local + arange(T_local)``. Returns [B, T_local, H, Dh] —
     each query attends over the FULL global sequence (causally masked).
+
+    ``comm_scale``: executions of this call per step beyond what tracing
+    sees — callers inside a scanned layer stack pass their layer count so
+    telemetry.comm's per-step byte accounting stays truthful (the K/V hop
+    ppermutes below already self-scale by the ring length; the backward
+    ring autodiff synthesizes is the documented under-count).
     """
     n = axis_size(axis_name)
     s = lax.axis_index(axis_name)
@@ -87,8 +95,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         l = alpha * l + p.sum(axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
             "bhts,bshd->bhtd", p.astype(v_c.dtype), v_c).astype(jnp.float32)
-        k_n = lax.ppermute(k_c, axis_name, perm)
-        v_n = lax.ppermute(v_c, axis_name, perm)
+        # scale = n·comm_scale: the scan body traces ONCE but hops n times
+        # per attention call, comm_scale attention calls per step.
+        k_n = comm.ppermute(k_c, axis_name, perm, label="ring_kv_hop",
+                            scale=n * comm_scale)
+        v_n = comm.ppermute(v_c, axis_name, perm, label="ring_kv_hop",
+                            scale=n * comm_scale)
         return (k_n, v_n, m_new, l, acc), None
 
     init = (k, v,
@@ -117,7 +129,10 @@ def _sp_logits(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     local_tok = _local_window(tokens, s, tl)
     positions = jnp.arange(tl) + s * tl                         # global RoPE
     h = llama.embed(params, local_tok, cfg)
-    attn = functools.partial(ring_attention, axis_name="seq", causal=True)
+    # comm_scale=n_layers: blocks_apply scans the layer stack, so the ring
+    # traces once for L executions per step.
+    attn = functools.partial(ring_attention, axis_name="seq", causal=True,
+                             comm_scale=cfg.n_layers)
     h = llama.blocks_apply(params["blocks"], h, cfg, positions, attn_fn=attn)
     return llama.head(params, h, cfg)
 
@@ -195,11 +210,11 @@ def make_sp_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
             state.params, tokens, cfg, n_seq)
         # Each shard computed grads from its local loss slice; the total
         # gradient is the sum over shards (loss was already globally scaled).
-        grads = lax.psum(grads, "seq")
-        loss = lax.psum(loss, "seq")
+        grads = comm.psum(grads, "seq", label="sp_grad_allreduce")
+        loss = comm.psum(loss, "seq", label="sp_loss_allreduce")
         if has_data:
-            grads = lax.pmean(grads, "data")
-            loss = lax.pmean(loss, "data")
+            grads = comm.pmean(grads, "data", label="grad_allreduce")
+            loss = comm.pmean(loss, "data", label="loss_allreduce")
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss

@@ -36,6 +36,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import comm
 from ._compat import shard_map
 
 from ..config import LlamaConfig
@@ -118,12 +119,18 @@ def make_tp_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     def sharded_grads(params: dict, tokens):
         loss, grads = jax.value_and_grad(_tp_loss)(params, tokens, cfg, tp)
         mask = _sharded_mask(grads)
+        # Telemetry note: the in-forward f/g psums inside llama.attention/
+        # mlp run under value_and_grad — autodiff synthesizes their
+        # transposes, which trace-time accounting cannot see (documented in
+        # telemetry/comm.py). The post-AD reductions below are exact.
         grads = jax.tree.map(
-            lambda g, s: g if s else lax.psum(g, "model"), grads, mask)
+            lambda g, s: g if s else comm.psum(g, "model",
+                                               label="tp_replicated_grads"),
+            grads, mask)
         loss = loss * tp                          # undo the 1/tp scaling
         if has_data:
-            grads = lax.pmean(grads, "data")
-            loss = lax.pmean(loss, "data")
+            grads = comm.pmean(grads, "data", label="grad_allreduce")
+            loss = comm.pmean(loss, "data", label="loss_allreduce")
         return loss, grads
 
     def step(state: TrainState, tokens):

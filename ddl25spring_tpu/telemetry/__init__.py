@@ -1,0 +1,100 @@
+"""Unified telemetry layer (ISSUE 2 tentpole).
+
+One observability subsystem the whole stack reports through:
+
+- ``events``: schema-versioned, append-only JSONL event stream (run
+  manifest, per-step records, fault events, FL round summaries).
+- ``registry``: MetricsRegistry — counters/gauges/histograms with
+  p50/p95/p99, absorbing Spans, StepTimer and ResilienceStats as adapters.
+- ``comm``: trace-time communication-volume accounting around the
+  collectives in parallel/{dp,tp,sp,ep,pp,compress}.py — bytes per
+  psum/all-gather per step, computed statically, zero in-jit overhead.
+- ``costs``: compiled-HLO cost analysis via lower().compile()
+  .cost_analysis(), guarded for jax API drift; cross-checks bench.py's
+  analytic FLOPs.
+- ``heartbeat``: atomic liveness file consumed by experiments/watchdog.py
+  as a first-class stall signal.
+
+``Telemetry`` bundles the per-run pieces (event log + heartbeat +
+registry) behind one handle the trainers/servers accept.
+Render a recorded run with ``python -m experiments.obs_report <dir>``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .costs import flops_crosscheck, hlo_cost
+from .events import (EventLog, SCHEMA_VERSION, default_run_id, read_events,
+                     validate_event)
+from .heartbeat import Heartbeat, read_heartbeat
+from .registry import MetricsRegistry
+
+# comm.py imports jax at module level; everything else here is stdlib-only.
+# Lazy re-export (PEP 562) keeps jax OUT of processes that only read
+# telemetry — the watchdog's LivenessMonitor and experiments/obs_report
+# import telemetry submodules and must stay featherweight/jax-free.
+_LAZY_COMM = ("CommProfile", "measure_comm")
+
+
+def __getattr__(name: str):
+    if name in _LAZY_COMM:
+        from . import comm
+        return getattr(comm, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "CommProfile", "EventLog", "Heartbeat", "MetricsRegistry",
+    "SCHEMA_VERSION", "Telemetry", "default_run_id", "flops_crosscheck",
+    "hlo_cost", "measure_comm", "read_events", "read_heartbeat",
+    "validate_event",
+]
+
+EVENTS_NAME = "events.jsonl"
+HEARTBEAT_NAME = "heartbeat.json"
+
+
+class Telemetry:
+    """Per-run telemetry bundle: event log + heartbeat + metrics registry.
+
+    >>> tel = Telemetry("/tmp/run")          # events.jsonl, heartbeat.json
+    >>> train_llm_dp(..., telemetry=tel)
+    >>> # python -m experiments.obs_report /tmp/run
+
+    ``step_every`` is the per-step event cadence — each step event forces a
+    host sync of the loss (same cost model as the trainers' ``loss_sink``),
+    so the default matches the trainers' ``sink_every``. The heartbeat is
+    sync-free and beats every iteration regardless.
+    """
+
+    def __init__(self, out_dir: str, *, run_id: Optional[str] = None,
+                 step_every: int = 10):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.run_id = run_id or default_run_id()
+        # Floor at 1: the trainers take `it % step_every`, and a 0 from a
+        # "disable step events" misread would ZeroDivisionError-sink the
+        # run — the one failure mode this layer promises never to cause.
+        self.step_every = max(1, int(step_every))
+        self.events = EventLog(os.path.join(out_dir, EVENTS_NAME),
+                               run_id=self.run_id)
+        self.heartbeat = Heartbeat(os.path.join(out_dir, HEARTBEAT_NAME))
+        self.registry = MetricsRegistry()
+
+    @property
+    def events_path(self) -> str:
+        return self.events.path
+
+    @property
+    def heartbeat_path(self) -> str:
+        return self.heartbeat.path
+
+    def close(self) -> None:
+        self.events.close()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

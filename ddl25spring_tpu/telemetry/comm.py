@@ -1,0 +1,252 @@
+"""Trace-time communication-volume accounting for the parallel layer.
+
+Evaluating the comm-efficiency directions in PAPERS.md — compressed
+allreduce (DynamiQ, arxiv 2602.08923) and quantized allreduce in XLA
+(EQuARX, arxiv 2506.17615) — needs per-collective byte counts that the
+stack previously never produced. This module provides them with ZERO
+in-jit overhead: the ``pmean``/``psum``/... wrappers below delegate
+straight to ``jax.lax`` (the compiled HLO is bit-identical to calling lax
+directly), but while JAX is *tracing* the step they record each
+collective's operand payload into the active collector. Tracing happens
+once per compilation, in Python, so the accounting is static — measured at
+trace time, free at run time.
+
+Usage: ``parallel/{dp,tp,sp,ep,pp,compress}.py`` call these wrappers
+instead of raw lax collectives, and
+
+    profile = measure_comm(step_fn, state, batch)   # or ShapeDtypeStructs
+
+abstractly traces the step (``jax.eval_shape`` — no compile, no execute)
+with a collector installed. The resulting ``CommProfile`` reports payload
+bytes and estimated per-device wire bytes per step, per collective label.
+
+Accounting semantics (what the numbers MEAN):
+- ``payload_bytes`` is the local operand size in its wire dtype — the
+  quantity the compression levers act on (bf16 halves it, int8 quarters
+  it vs fp32).
+- ``wire_bytes_per_device`` applies the standard ring-algorithm factors to
+  the payload: allreduce (psum/pmean/pmax) ``2·(n−1)/n``, all_gather
+  ``(n−1)`` × the local shard sent, psum_scatter ``(n−1)/n``, ppermute
+  ``1`` (one neighbor send). n = the mesh axis size; n = 1 makes every
+  reduce's wire cost 0, as it should.
+- ``scale`` multiplies a record for collectives inside ``lax.scan`` bodies,
+  which trace once but execute many times — the call site passes the trip
+  count (e.g. the SP ring passes its hop count, PP its tick count).
+
+Known under-count, by design: collectives SYNTHESIZED by autodiff
+transposition (e.g. the backward hops of a differentiated in-forward
+ppermute, or psum transposes in TP/PP forward bodies) never appear in user
+code, so trace-time accounting cannot see them. The post-AD data-parallel
+collectives — the gradient allreduce family that the compressed-wire work
+targets — are exact. Call sites that KNOW their op is differentiated pass
+``scale=2`` (forward + cotangent) where that correction applies.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+from jax import lax
+
+_collector: contextvars.ContextVar[Optional[list]] = \
+    contextvars.ContextVar("ddl25_comm_collector", default=None)
+
+
+@dataclass(frozen=True)
+class CommRecord:
+    """One collective call site, as seen at trace time."""
+    op: str                  # pmean | psum | pmax | all_gather | ...
+    label: str               # call-site semantic name ("grad_allreduce", ...)
+    axis: str                # mesh axis name
+    axis_size: Optional[int]  # None when not resolvable at trace time
+    payload_bytes: int       # local operand bytes in the wire dtype
+    scale: int               # executions per step (scan trip count, ...)
+
+    @property
+    def wire_bytes_per_device(self) -> float:
+        """Ring-algorithm per-device wire estimate for ONE execution.
+
+        An unresolvable axis size (both `_axis_size` probes failed — future
+        API drift) must NOT silently zero the reduce factors the way n=1
+        legitimately does: report factor 1.0 (within 2x of any real ring
+        reduce) and let the record's ``axis_size: None`` flag the estimate
+        as degraded."""
+        n = self.axis_size
+        if n is None:
+            return float(self.payload_bytes)
+        if self.op in ("pmean", "psum", "pmax"):
+            factor = 2.0 * (n - 1) / n
+        elif self.op == "all_gather":
+            factor = float(n - 1)
+        elif self.op == "psum_scatter":
+            factor = (n - 1) / n
+        elif self.op == "ppermute":
+            factor = 1.0 if n > 1 else 0.0
+        else:
+            factor = 1.0
+        return factor * self.payload_bytes
+
+    def as_dict(self) -> dict:
+        return {"op": self.op, "label": self.label, "axis": self.axis,
+                "axis_size": self.axis_size,
+                "payload_bytes": int(self.payload_bytes),
+                "scale": int(self.scale),
+                "wire_bytes_per_device": self.wire_bytes_per_device}
+
+
+@dataclass
+class CommProfile:
+    """All collectives of one traced step, with per-step aggregates."""
+    records: List[CommRecord] = field(default_factory=list)
+
+    @property
+    def payload_bytes_per_step(self) -> int:
+        return sum(r.payload_bytes * r.scale for r in self.records)
+
+    @property
+    def wire_bytes_per_device_per_step(self) -> float:
+        return sum(r.wire_bytes_per_device * r.scale for r in self.records)
+
+    def by_label(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for r in self.records:
+            agg = out.setdefault(r.label, {
+                "op": r.op, "axis": r.axis, "axis_size": r.axis_size,
+                "calls": 0, "payload_bytes": 0,
+                "wire_bytes_per_device": 0.0})
+            agg["calls"] += r.scale
+            agg["payload_bytes"] += r.payload_bytes * r.scale
+            agg["wire_bytes_per_device"] += r.wire_bytes_per_device * r.scale
+        return out
+
+    def as_dict(self) -> dict:
+        """JSON-able shape for the run manifest / bench telemetry block."""
+        return {
+            "payload_bytes_per_step": self.payload_bytes_per_step,
+            "wire_bytes_per_device_per_step":
+                self.wire_bytes_per_device_per_step,
+            "collectives": self.by_label(),
+        }
+
+
+def _tree_bytes(tree: Any) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+        total += int(math.prod(shape)) * itemsize
+    return total
+
+
+def _axis_size(axis_name: str) -> Optional[int]:
+    """Static axis size at trace time, across this jax's API drift
+    (0.4.37: ``core.axis_frame(name)`` returns a plain int; newer builds
+    have ``lax.axis_size``; see parallel/_compat.py, not imported here to
+    keep telemetry dependency-free of the parallel layer)."""
+    try:
+        return int(lax.axis_size(axis_name))          # newer jax
+    except Exception:
+        pass
+    try:
+        frame = jax.core.axis_frame(axis_name)        # jax 0.4.37
+        return int(getattr(frame, "size", frame))
+    except Exception:
+        return None
+
+
+def _record(op: str, label: Optional[str], axis_name: str, operand: Any,
+            scale: int) -> None:
+    col = _collector.get()
+    if col is None:
+        return
+    col.append(CommRecord(op=op, label=label or op, axis=axis_name,
+                          axis_size=_axis_size(axis_name),
+                          payload_bytes=_tree_bytes(operand),
+                          scale=int(scale)))
+
+
+# ------------------------------------------------------------- the wrappers
+# Same signatures as jax.lax (plus label/scale); compiled output identical.
+
+def pmean(x, axis_name: str, *, label: Optional[str] = None,
+          scale: int = 1):
+    _record("pmean", label, axis_name, x, scale)
+    return lax.pmean(x, axis_name)
+
+
+def psum(x, axis_name: str, *, label: Optional[str] = None, scale: int = 1):
+    _record("psum", label, axis_name, x, scale)
+    return lax.psum(x, axis_name)
+
+
+def pmax(x, axis_name: str, *, label: Optional[str] = None, scale: int = 1):
+    _record("pmax", label, axis_name, x, scale)
+    return lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, tiled: bool = False,
+               label: Optional[str] = None, scale: int = 1):
+    _record("all_gather", label, axis_name, x, scale)
+    return lax.all_gather(x, axis_name, tiled=tiled)
+
+
+def psum_scatter(x, axis_name: str, *, scatter_dimension: int = 0,
+                 tiled: bool = False, label: Optional[str] = None,
+                 scale: int = 1):
+    _record("psum_scatter", label, axis_name, x, scale)
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def ppermute(x, axis_name: str, perm, *, label: Optional[str] = None,
+             scale: int = 1):
+    _record("ppermute", label, axis_name, x, scale)
+    return lax.ppermute(x, axis_name, perm)
+
+
+# ------------------------------------------------------------- measurement
+
+@contextlib.contextmanager
+def collecting() -> Iterator[List[CommRecord]]:
+    """Install a fresh collector for the duration of the block; any tracing
+    that happens inside lands its collective records in the yielded list."""
+    records: List[CommRecord] = []
+    token = _collector.set(records)
+    try:
+        yield records
+    finally:
+        _collector.reset(token)
+
+
+def measure_comm(fn, *args, **kwargs) -> Optional[CommProfile]:
+    """Static comm profile of one call of ``fn(*args)``.
+
+    Abstractly traces ``fn`` via ``jax.eval_shape`` — no compile, no
+    execution, and the trace lands in the jit cache, so measuring a
+    freshly built step BEFORE its first real call costs nothing extra.
+    Arguments may be real pytrees or ``jax.ShapeDtypeStruct``s.
+
+    A function whose trace is already cached re-uses it without running the
+    Python body, which would silently record nothing — in that case the
+    one retry after ``jax.clear_caches()`` forces a fresh trace (and evicts
+    warm compilations: prefer measuring before first execution). Returns
+    None when tracing itself fails.
+    """
+    for attempt in (0, 1):
+        with collecting() as records:
+            try:
+                jax.eval_shape(fn, *args, **kwargs)
+            except Exception:
+                return None
+        if records:
+            return CommProfile(records)
+        if attempt == 0:
+            jax.clear_caches()
+    return CommProfile([])       # traced fresh; genuinely no collectives

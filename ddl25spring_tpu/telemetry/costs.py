@@ -1,0 +1,90 @@
+"""Compiled-HLO cost accounting, guarded for jax API drift.
+
+`bench.py` computes MFU from a hand-derived analytic FLOP formula
+(``train_step_flops_per_token``). This module pulls the OTHER source of
+truth — XLA's own cost model for the compiled step, via
+``jitted.lower(...).compile().cost_analysis()`` — so the two can
+cross-check each other. The API has drifted across jax versions (dict vs
+list-of-dicts results, methods missing on some backends, backends that
+return None), so everything here follows the repo's version-shim precedent
+(parallel/_compat.py, experiments/_cpu_pin.py): probe, normalize, and
+degrade to None rather than crash — a bench must never die because a
+jaxlib can't count its own FLOPs.
+
+On this container's jax 0.4.37 / jaxlib 0.4.36 CPU backend,
+``cost_analysis()`` returns ``[{"flops": ..., "bytes accessed": ...}]``
+(verified; tests/test_telemetry.py pins the guard behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def hlo_cost(jitted_fn, *args, **kwargs) -> Optional[dict]:
+    """Cost analysis of the compiled program for ``jitted_fn(*args)``.
+
+    Returns ``{"flops": float, "bytes_accessed": float | None}`` or None
+    when any link of the lower→compile→cost_analysis chain is unavailable
+    on this jax/jaxlib/backend. Arguments may be real pytrees or
+    ``jax.ShapeDtypeStruct``s. NOTE: compiles the program if it isn't
+    already — call where a compile is acceptable (bench/report time), not
+    on a hot path.
+    """
+    lower = getattr(jitted_fn, "lower", None)
+    if lower is None:
+        return None                       # not a jitted callable
+    try:
+        compiled = lower(*args, **kwargs).compile()
+        analysis = compiled.cost_analysis()
+    except Exception:
+        return None
+    return _normalize(analysis)
+
+
+def _normalize(analysis: Any) -> Optional[dict]:
+    """list-of-dicts (one per partition; 0.4.x) or plain dict → one dict."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    flops = analysis.get("flops")
+    if flops is None:
+        return None
+    try:
+        flops = float(flops)
+    except (TypeError, ValueError):
+        return None
+    if flops < 0:                          # some backends report -1
+        return None
+    bytes_accessed = analysis.get("bytes accessed",
+                                  analysis.get("bytes_accessed"))
+    try:
+        bytes_accessed = (float(bytes_accessed)
+                          if bytes_accessed is not None else None)
+    except (TypeError, ValueError):
+        bytes_accessed = None
+    return {"flops": flops, "bytes_accessed": bytes_accessed}
+
+
+def flops_crosscheck(analytic_flops: float, hlo: Optional[dict],
+                     tolerance: float = 0.10) -> dict:
+    """Compare the analytic FLOP count against the compiled program's.
+
+    Returns ``{"flops_source", "hlo_flops", "rel_err"}``:
+    - ``"hlo"`` when the compiled-program count is available and within
+      ``tolerance`` relative error of the analytic formula — the formula is
+      then cross-checked by the compiler;
+    - ``"analytic"`` when cost_analysis is unavailable on this jaxlib or
+      the two diverge beyond tolerance (caller should warn: either the
+      formula or the lowering changed).
+
+    Both counts must cover the SAME program (same config, batch, seq).
+    """
+    if hlo is None or not analytic_flops:
+        return {"flops_source": "analytic", "hlo_flops": None,
+                "rel_err": None}
+    rel = abs(hlo["flops"] - analytic_flops) / analytic_flops
+    source = "hlo" if rel <= tolerance else "analytic"
+    return {"flops_source": source, "hlo_flops": hlo["flops"],
+            "rel_err": rel}

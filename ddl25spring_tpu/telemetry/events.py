@@ -1,0 +1,282 @@
+"""Schema-versioned, append-only JSONL event stream.
+
+The one place every layer of the stack reports through (ISSUE 2 tentpole):
+training loops emit per-step records and fault events, FL servers emit round
+summaries, and every run opens with a manifest carrying its configuration
+and static communication profile. `experiments/obs_report.py` renders the
+stream back into a human report; `tests/test_telemetry.py` pins the
+round-trip.
+
+Write contract:
+- One event per line, compact JSON, written as ONE ``write()`` call on an
+  ``O_APPEND`` file descriptor (looped only if the kernel writes short —
+  e.g. ENOSPC mid-line, after which the next emit seals the fragment with
+  a newline). Within one process the lock makes every line atomic. Across
+  processes sharing a log, Linux local filesystems perform each O_APPEND
+  write as one atomic append so lines do not interleave — but that is a
+  Linux-local-fs behavior, not a POSIX guarantee (NFS, notably, can
+  interleave); a reader tolerates a torn FINAL line either way, and a
+  reopening writer truncates one (below).
+- Every event carries ``schema`` (version), ``run_id``, ``seq`` (per-writer
+  monotonic), ``t`` (epoch seconds) and ``type``. Unknown types and extra
+  fields are legal — readers must ignore what they don't know (the same
+  forward-compat posture as ResultSink's header widening).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+SCHEMA_VERSION = 1
+
+# Event types this schema version defines. Emitters may add new types
+# freely; ``validate_event`` checks base fields for ALL types and the
+# per-type required fields only for the known ones.
+EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end")
+
+_BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
+_REQUIRED: Dict[str, tuple] = {
+    "manifest": ("jax_version", "platform"),
+    "step": ("it",),
+    "fault": ("counters",),
+    "fl_round": ("round",),
+    "run_end": ("steps",),
+}
+
+
+def default_run_id() -> str:
+    return f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+
+
+class EventLog:
+    """Append-only JSONL event writer (thread-safe; crash-tolerant reads).
+
+    >>> log = EventLog("/tmp/run/events.jsonl")
+    >>> log.manifest(jax_version=jax.__version__, platform="cpu")
+    >>> log.step(it=10, loss=2.31, dt_s=0.4)
+    """
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id or default_run_id()
+        self._seq = 0
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # O_APPEND at the fd level: every write() lands at the current end
+        # of file even if another process appended in between.
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                           0o644)
+        self.write_errors = 0
+        self._torn_tail = False  # our own partial write left file mid-line
+        # Heal a torn final line left by a crashed predecessor (a relaunch
+        # reusing the same telemetry dir): without healing, this writer's
+        # first event would merge into the fragment, turning an expected
+        # crash artifact (readers drop a torn FINAL line) into mid-file
+        # corruption (strict readers raise). Truncating to the last
+        # newline discards exactly the bytes every reader would drop; the
+        # write contract (whole lines in one write()) means a file not
+        # ending in '\n' is a dead writer's fragment, not an in-flight
+        # append.
+        try:
+            size = os.fstat(self._fd).st_size
+            if size > 0:
+                with open(path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    if f.read(1) != b"\n":
+                        # Scan BACKWARDS in chunks for the last newline:
+                        # the fragment is one partial line, but the log a
+                        # long-lived dir accumulates can be huge — reading
+                        # it all just to rfind would cost O(file) memory.
+                        pos, keep, chunk = size, 0, 1 << 16
+                        while pos > 0:
+                            start = max(0, pos - chunk)
+                            f.seek(start)
+                            nl = f.read(pos - start).rfind(b"\n")
+                            if nl != -1:
+                                keep = start + nl + 1
+                                break
+                            pos = start
+                        os.ftruncate(self._fd, keep)
+        except OSError:
+            pass
+
+    def emit(self, type: str, **fields: Any) -> Dict[str, Any]:
+        """Append one event; returns the record (as written, or as dropped).
+
+        Never raises on IO failure: telemetry must not sink a trainer (same
+        policy as ``Heartbeat.beat`` — a full disk kills the event, counted
+        in ``write_errors``, not the run). Emitting after ``close()`` also
+        just counts."""
+        with self._lock:
+            self._seq += 1
+            record = {"schema": SCHEMA_VERSION, "run_id": self.run_id,
+                      "seq": self._seq, "t": time.time(), "type": type}
+            record.update(fields)
+            data = b""
+            wrote = 0
+            try:
+                # Sanitize + dumps inside the try: either can still raise
+                # (non-string dict keys, circular structures) and that too
+                # must count, not sink the trainer. allow_nan=False is the
+                # backstop: json.dumps would otherwise emit NaN/Infinity
+                # tokens — which Python's loads tolerates but strict JSON
+                # consumers (jq, the CI artifact viewers) reject — for any
+                # non-finite float _sanitize missed.
+                record = _sanitize(record)
+                line = json.dumps(record, separators=(",", ":"),
+                                  allow_nan=False) + "\n"
+                if self._fd is None:
+                    raise OSError("EventLog is closed")
+                data = line.encode()
+                if self._torn_tail:
+                    # A prior partial write left the file mid-line; a
+                    # leading newline seals that fragment into ONE
+                    # malformed line (skipped by non-strict readers)
+                    # instead of letting this event merge into it and
+                    # corrupt both.
+                    data = b"\n" + data
+                # os.write may write short (ENOSPC hit mid-line, or any
+                # byte count on POSIX) — loop, tracking progress so a
+                # failure mid-line is repairable (above).
+                view = memoryview(data)
+                while view:
+                    n = os.write(self._fd, view)
+                    wrote += n
+                    view = view[n:]
+                self._torn_tail = False
+            except (OSError, TypeError, ValueError, RecursionError):
+                self.write_errors += 1
+                if wrote:   # 0 bytes = file unchanged, keep prior state
+                    self._torn_tail = wrote < len(data)
+        return record
+
+    # Typed conveniences — thin, so the schema has one authoritative shape.
+    def manifest(self, **fields) -> Dict[str, Any]:
+        return self.emit("manifest", **fields)
+
+    def step(self, *, it: int, **fields) -> Dict[str, Any]:
+        return self.emit("step", it=it, **fields)
+
+    def fault(self, *, counters: Dict[str, int], **fields) -> Dict[str, Any]:
+        return self.emit("fault", counters=counters, **fields)
+
+    def fl_round(self, *, round: int, **fields) -> Dict[str, Any]:
+        return self.emit("fl_round", round=round, **fields)
+
+    def run_end(self, *, steps: int, **fields) -> Dict[str, Any]:
+        return self.emit("run_end", steps=steps, **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _json_fallback(obj):
+    """Last-resort serializer: numpy/jax scalars → Python, else str."""
+    for attr in ("item",):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:
+                pass
+    return str(obj)
+
+
+def _sanitize(obj):
+    """Make ``obj`` strictly-JSON-serializable: numpy/jax scalars → Python
+    (via ``_json_fallback``) and non-finite floats → their ``str()``
+    ("nan"/"inf"/"-inf" stay visible in the stream instead of becoming
+    invalid NaN/Infinity tokens). Dict keys are left alone — a non-string
+    key is a caller bug that json.dumps reports (and ``emit`` counts)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else str(obj)
+    if isinstance(obj, (str, int, bool)) or obj is None:
+        return obj
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return _sanitize(_json_fallback(obj))
+
+
+def validate_event(event: Dict[str, Any]) -> List[str]:
+    """Schema check; returns a list of problems (empty = valid).
+
+    Base fields are required for every event; per-type required fields only
+    for the types this schema version knows. A FUTURE schema version is a
+    problem (the reader can't promise to understand it); unknown event
+    types are not (forward compat).
+    """
+    problems = [f"missing field {f!r}" for f in _BASE_FIELDS
+                if f not in event]
+    schema = event.get("schema")
+    if isinstance(schema, int) and schema > SCHEMA_VERSION:
+        problems.append(f"schema {schema} is newer than reader "
+                        f"({SCHEMA_VERSION})")
+    for f in _REQUIRED.get(event.get("type"), ()):
+        if f not in event:
+            problems.append(f"{event.get('type')}: missing field {f!r}")
+    return problems
+
+
+def read_events(path: str, *, strict: bool = False,
+                types: Optional[tuple] = None) -> List[Dict[str, Any]]:
+    """Parse a JSONL event stream, tolerating a torn final line.
+
+    A crash mid-append can leave a partial LAST line; that one is dropped
+    silently. A malformed line anywhere else is corruption and raises under
+    ``strict``; otherwise it is skipped. ``types`` filters by event type.
+    """
+    events: List[Dict[str, Any]] = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = raw.split(b"\n")
+    complete = raw.endswith(b"\n")
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            event = json.loads(line)
+            if not isinstance(event, dict):
+                # Valid JSON but not an event object (`null`, a number, a
+                # list) — same corruption class as a parse failure; letting
+                # it through would crash every consumer's `.get`.
+                raise ValueError(f"non-object event: {line[:40]!r}")
+        except ValueError:
+            if i == len(lines) - 1 and not complete:
+                continue                       # torn final line: expected
+            if strict:
+                raise
+            continue
+        if strict:
+            problems = validate_event(event)
+            if problems:
+                raise ValueError(f"{path}:{i + 1}: {problems}")
+        if types is None or event.get("type") in types:
+            events.append(event)
+    return events
+
+
+def iter_runs(events: List[Dict[str, Any]]) -> Iterator[List[Dict[str, Any]]]:
+    """Group a (possibly multi-run) event list into per-run_id sublists,
+    preserving first-seen order."""
+    by_run: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_run.setdefault(e.get("run_id", "?"), []).append(e)
+    yield from by_run.values()

@@ -27,6 +27,7 @@ from ..models import llama
 from ..parallel import dp, make_mesh, pp
 from ..resilience.preemption import PreemptionHandler
 from ..tokenizers import load_tokenizer
+from ..utils.tracing import Spans
 
 
 @dataclass
@@ -141,11 +142,42 @@ def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
     return ckpt, state, start_step, False
 
 
+def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
+                   mesh, start_step: int, step_fn, state, n_data: int
+                   ) -> None:
+    """Open a telemetry run: one manifest event carrying the configuration
+    and the step's static communication profile (telemetry/comm.py —
+    measured by abstract tracing BEFORE the first real call, so the trace
+    lands in the jit cache and costs nothing extra). Must run on the
+    UNGUARDED step: StepGuard's host-side logic cannot be eval_shape'd."""
+    if telemetry is None:
+        return
+    import dataclasses
+
+    from ..telemetry import measure_comm
+    comm_profile = None
+    try:
+        batch_sds = jax.ShapeDtypeStruct(
+            (n_data * train_cfg.batch_size, train_cfg.seq_len), jnp.int32)
+        profile = measure_comm(step_fn, state, batch_sds)
+        comm_profile = profile.as_dict() if profile is not None else None
+    except Exception:
+        pass                       # telemetry must never sink a trainer
+    telemetry.events.manifest(
+        trainer=trainer, jax_version=jax.__version__,
+        platform=jax.devices()[0].platform, n_devices=len(jax.devices()),
+        mesh={k: int(v) for k, v in mesh.shape.items()},
+        model_cfg=dataclasses.asdict(model_cfg),
+        train_cfg=dataclasses.asdict(train_cfg),
+        start_step=start_step, comm=comm_profile)
+
+
 def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               n_data: int, start_step: int, ckpt, checkpoint_every: int,
               loss_sink, sink_every: int, log_every: int, log_fn,
               warmup_steps_excluded: int,
-              stats: Optional[ResilienceStats] = None) -> LLMTrainReport:
+              stats: Optional[ResilienceStats] = None,
+              telemetry=None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
     and async-honest throughput accounting (the timer starts after
@@ -169,6 +201,11 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     report = LLMTrainReport()
     report.start_step = start_step
     report.resilience = stats if stats is not None else ResilienceStats()
+    spans = Spans()  # phase accounting; absorbed into the registry at end
+    last_event_t = time.perf_counter()
+    last_event_it = start_step - 1
+    last_replay_beat = -math.inf  # first replayed batch always beats
+    prev_counters = report.resilience.as_dict()
     last_saved = -1
     tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
     t_start = None
@@ -182,9 +219,19 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     last_it = start_step - 1
     with preempt:
         for it in range(train_cfg.iters):
-            host_batch = next(batches).reshape(
-                n_data * train_cfg.batch_size, train_cfg.seq_len)
+            with spans("data"):
+                host_batch = next(batches).reshape(
+                    n_data * train_cfg.batch_size, train_cfg.seq_len)
             if it < start_step:
+                # Replaying IS progress, but a beat per replayed batch
+                # would add thousands of temp-file renames to an otherwise
+                # host-only fast-forward; throttle to well under the
+                # watchdog's polling granularity.
+                if telemetry is not None:
+                    now = time.perf_counter()
+                    if now - last_replay_beat >= 0.5:
+                        telemetry.heartbeat.beat(step=it, phase="replay")
+                        last_replay_beat = now
                 continue  # resume: replay the stream, preserving data order
             if preempt.requested:
                 # Force-save a resumable checkpoint BEFORE dying: the next
@@ -206,23 +253,57 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                        f"{'' if ckpt is not None else ' (no checkpoint dir)'}")
                 break
             last_it = it
-            state, loss = step_fn(state, shard_fn(host_batch))
+            t_iter = time.perf_counter()
+            with spans("dispatch"):
+                state, loss = step_fn(state, shard_fn(host_batch))
             if it + 1 == start_step + warmup_steps_excluded:
                 float(loss)  # hard sync before starting the timer
                 t_start = time.perf_counter()
+                # Re-baseline the step-event window too: the time before
+                # this sync is compile + (on resume) stream replay, which
+                # would otherwise land in the first window's dt_s and
+                # dominate obs_report's step-time percentiles.
+                last_event_t, last_event_it = t_start, it
             device_losses.append(loss)
             if loss_sink is not None and (it % sink_every == 0
                                           or it == train_cfg.iters - 1):
                 loss_sink(it, float(loss))
             if log_every and it % log_every == 0:
                 log_fn(f"iter {it}: loss {float(loss):.4f}")
+            if telemetry is not None:
+                # Host-side iteration wall time: dispatch + host work, NOT
+                # device completion (no sync; under async dispatch read the
+                # honest throughput from tokens_per_sec / the step events).
+                telemetry.registry.observe("host_iter_s",
+                                           time.perf_counter() - t_iter)
+                telemetry.heartbeat.beat(step=it)
+                if (it % telemetry.step_every == 0
+                        or it == train_cfg.iters - 1):
+                    now = time.perf_counter()
+                    extra = {}
+                    if t_start is None:
+                        # Pre-baseline window: dt_s still contains one-time
+                        # compile/replay. Keep the event (its loss matters)
+                        # but flag it so readers exclude it from step-time
+                        # distributions (obs_report does).
+                        extra["warmup"] = True
+                    telemetry.events.step(
+                        it=it, loss=float(loss),  # the documented sync
+                        dt_s=now - last_event_t,
+                        steps=it - last_event_it, **extra)
+                    last_event_t, last_event_it = now, it
+                delta = report.resilience.delta(prev_counters)
+                if delta:
+                    telemetry.events.fault(counters=delta, it=it)
+                    prev_counters = report.resilience.as_dict()
             if ckpt is not None and (it + 1) % checkpoint_every == 0:
                 try:
                     # overwrite: after a corrupt-latest fallback resume the
                     # loop re-treads step indices the dead lineage already
                     # wrote (start_step < it+1 <= old latest), and those
                     # stale entries must not survive as restore candidates.
-                    ckpt.save(it + 1, state, overwrite=True)
+                    with spans("checkpoint"):
+                        ckpt.save(it + 1, state, overwrite=True)
                     last_saved = it + 1
                 except Exception as e:
                     log_fn(f"periodic checkpoint at {it + 1} failed after "
@@ -238,6 +319,15 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
         report.wall_time = time.perf_counter() - t_start
         timed = report.steps - warmup_steps_excluded
         report.tokens_per_sec = tokens_per_step * timed / report.wall_time
+    if telemetry is not None:
+        telemetry.registry.absorb_spans(spans)
+        telemetry.registry.absorb_resilience(report.resilience)
+        telemetry.events.run_end(
+            steps=report.steps, start_step=start_step,
+            preempted=report.preempted,
+            tokens_per_sec=report.tokens_per_sec, wall_s=report.wall_time,
+            metrics=telemetry.registry.snapshot())
+        telemetry.heartbeat.beat(step=last_it + 1, phase="done")
     return report
 
 
@@ -276,7 +366,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  loss_sink: Optional[Callable[[int, float], None]] = None,
                  sink_every: int = 10,
                  resilience: Optional[ResilienceConfig] = None,
-                 fault_plan=None) -> LLMTrainReport:
+                 fault_plan=None,
+                 telemetry=None) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
     ``aggregation``: "gradient" (allreduce grads — intro_DP_GA) or "weight"
@@ -301,6 +392,11 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     consecutive bad steps) and carries the checkpoint-IO retry budget.
     ``fault_plan`` (resilience.FaultPlan) injects deterministic faults for
     tests/chaos runs; counters come back in ``report.resilience``.
+
+    ``telemetry`` (telemetry.Telemetry) opens the run's observability
+    surface: a manifest event with the step's static comm profile, per-step
+    records + heartbeat from the loop, fault deltas, and a run_end metrics
+    snapshot — render with ``python -m experiments.obs_report <dir>``.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
@@ -352,6 +448,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         resilience=resilience, stats=stats)
     if done:
         return LLMTrainReport(resilience=stats)
+    _emit_manifest(telemetry, trainer="dp", model_cfg=model_cfg,
+                   train_cfg=train_cfg, mesh=mesh, start_step=start_step,
+                   step_fn=step_fn, state=state, n_data=n_data)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     # Disjoint stream windows per data shard — the reference's skip=rank*5000.
@@ -364,7 +463,7 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      sink_every=sink_every, log_every=log_every,
                      log_fn=log_fn,
                      warmup_steps_excluded=warmup_steps_excluded,
-                     stats=stats)
+                     stats=stats, telemetry=telemetry)
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
@@ -380,7 +479,8 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                  loss_sink: Optional[Callable[[int, float], None]] = None,
                  sink_every: int = 10,
                  resilience: Optional[ResilienceConfig] = None,
-                 fault_plan=None) -> LLMTrainReport:
+                 fault_plan=None,
+                 telemetry=None) -> LLMTrainReport:
     """Pipeline(-x-data)-parallel tiny-Llama training; returns losses and
     throughput.
 
@@ -426,6 +526,9 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         resilience=resilience, stats=stats)
     if done:
         return LLMTrainReport(resilience=stats)
+    _emit_manifest(telemetry, trainer="pp", model_cfg=model_cfg,
+                   train_cfg=train_cfg, mesh=mesh, start_step=start_step,
+                   step_fn=step_fn, state=state, n_data=n_data)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
@@ -437,4 +540,4 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                      sink_every=sink_every, log_every=log_every,
                      log_fn=log_fn,
                      warmup_steps_excluded=warmup_steps_excluded,
-                     stats=stats)
+                     stats=stats, telemetry=telemetry)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 import csv
 import os
+import threading
 import time
 from collections import defaultdict
 from typing import Any, Dict, Iterator, List, Optional
@@ -29,6 +30,10 @@ import jax
 class Spans:
     """Named wall-clock accumulators, the RunResult phase-accounting helper.
 
+    Thread-safe: a watchdog/monitoring thread and the training thread may
+    accumulate into one instance concurrently (the lock covers the
+    read-modify-write of the accumulators, not the timed block itself).
+
     >>> spans = Spans()
     >>> with spans("update"):
     ...     do_work()
@@ -36,6 +41,7 @@ class Spans:
     """
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._acc: Dict[str, float] = defaultdict(float)
         self._count: Dict[str, int] = defaultdict(int)
 
@@ -45,21 +51,27 @@ class Spans:
         try:
             yield
         finally:
-            self._acc[name] += time.perf_counter() - t0
-            self._count[name] += 1
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._acc[name] += dt
+                self._count[name] += 1
 
     def total(self, name: str) -> float:
-        return self._acc[name]
+        with self._lock:
+            return self._acc[name]
 
     def count(self, name: str) -> int:
-        return self._count[name]
+        with self._lock:
+            return self._count[name]
 
     def as_dict(self) -> Dict[str, float]:
-        return dict(self._acc)
+        with self._lock:
+            return dict(self._acc)
 
     def reset(self) -> None:
-        self._acc.clear()
-        self._count.clear()
+        with self._lock:
+            self._acc.clear()
+            self._count.clear()
 
 
 @contextlib.contextmanager
@@ -76,27 +88,40 @@ def device_trace(log_dir: str) -> Iterator[None]:
 
 class StepTimer:
     """Per-step timing that is honest under async dispatch: ``tick`` blocks
-    on the step's outputs before reading the clock."""
+    on the step's outputs before reading the clock.
+
+    ``tick()`` before ``start()`` raises instead of silently recording a
+    0.0 step (the old behavior poisoned means with zeros — percentile
+    consumers in telemetry.MetricsRegistry would inherit the lie).
+    Thread-safe for the same reason as Spans."""
 
     def __init__(self):
         self.times: List[float] = []
         self._t0: Optional[float] = None
+        self._lock = threading.Lock()
 
     def start(self) -> None:
-        self._t0 = time.perf_counter()
+        with self._lock:
+            self._t0 = time.perf_counter()
 
     def tick(self, *outputs) -> float:
         for out in outputs:
             jax.block_until_ready(out)
         now = time.perf_counter()
-        dt = now - (self._t0 if self._t0 is not None else now)
-        self.times.append(dt)
-        self._t0 = now
+        with self._lock:
+            if self._t0 is None:
+                raise RuntimeError(
+                    "StepTimer.tick() before start(): the interval has no "
+                    "beginning — call start() once before the timed loop")
+            dt = now - self._t0
+            self.times.append(dt)
+            self._t0 = now
         return dt
 
     @property
     def mean(self) -> float:
-        return sum(self.times) / max(len(self.times), 1)
+        with self._lock:
+            return sum(self.times) / max(len(self.times), 1)
 
 
 def atomic_write_csv(path: str, fieldnames: List[str],
@@ -130,10 +155,16 @@ class ResultSink:
     Accepts dicts or RunResult-like objects (anything with ``as_df``); the
     CSV header is taken from the first record (reference idiom: results
     persisted to CSV for re-plotting, hw03 cells 11, 18, 29).
+
+    Thread-safe within one process: concurrent ``write`` calls (training
+    thread + watchdog/monitor thread) serialize on a lock, so a
+    header-widening rewrite can never interleave with another append and
+    drop rows (pinned in tests/test_telemetry.py).
     """
 
     def __init__(self, path: str):
         self.path = path
+        self._lock = threading.Lock()
         self._fieldnames: Optional[List[str]] = None
         if os.path.exists(path):
             with open(path, newline="") as f:
@@ -143,9 +174,13 @@ class ResultSink:
     def write(self, record: Any) -> None:
         if hasattr(record, "as_df"):
             for row in record.as_df().to_dict(orient="records"):
-                self._write_row(row)
+                self._locked_write_row(row)
         else:
-            self._write_row(dict(record))
+            self._locked_write_row(dict(record))
+
+    def _locked_write_row(self, row: Dict[str, Any]) -> None:
+        with self._lock:
+            self._write_row(row)
 
     def _write_row(self, row: Dict[str, Any]) -> None:
         new_file = self._fieldnames is None

@@ -35,7 +35,8 @@ CONFIGS = {
 
 def _run_config(name: str, iters: int, sink, provenance: str,
                 checkpoint_dir: str = None, faults: str = "",
-                fault_seed: int = 0, guard: bool = False) -> Dict[str, float]:
+                fault_seed: int = 0, guard: bool = False,
+                telemetry_dir: str = None) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
@@ -64,10 +65,29 @@ def _run_config(name: str, iters: int, sink, provenance: str,
         from ddl25spring_tpu.config import ResilienceConfig
         kw["resilience"] = ResilienceConfig(guard=guard, faults=faults,
                                             fault_seed=fault_seed)
-    if topo["stage"] > 1:
-        report = train_llm_pp(model_cfg, train_cfg, log_every=log_every, **kw)
-    else:
-        report = train_llm_dp(model_cfg, train_cfg, log_every=log_every, **kw)
+    telemetry = None
+    if telemetry_dir is not None:
+        # Unified observability (ddl25spring_tpu/telemetry): JSONL event
+        # stream + heartbeat per config (configs are separate runs — one
+        # dir each, so obs_report and the watchdog's --heartbeat have an
+        # unambiguous target). Render afterwards with
+        #   python -m experiments.obs_report <telemetry-dir>/<config>
+        import os as _os
+
+        from ddl25spring_tpu.telemetry import Telemetry
+        telemetry = Telemetry(_os.path.join(telemetry_dir, name))
+        kw["telemetry"] = telemetry
+    try:
+        if topo["stage"] > 1:
+            report = train_llm_pp(model_cfg, train_cfg, log_every=log_every,
+                                  **kw)
+        else:
+            report = train_llm_dp(model_cfg, train_cfg, log_every=log_every,
+                                  **kw)
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+            print(f"{name}: telemetry -> {telemetry.out_dir}", flush=True)
     if report.resilience is not None and (faults or guard):
         print(f"{name}: resilience counters "
               f"{ {k: v for k, v in report.resilience.as_dict().items() if v} }",
@@ -95,7 +115,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
 def main(quick: bool = False, iters: int = 5000,
          configs=("dp1",), append: bool = False,
          checkpoint_dir: str = None, faults: str = "",
-         fault_seed: int = 0, guard: bool = False) -> Dict[str, float]:
+         fault_seed: int = 0, guard: bool = False,
+         telemetry_dir: str = None) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -121,7 +142,8 @@ def main(quick: bool = False, iters: int = 5000,
     for name in configs:
         out.update(_run_config(name, iters, sink, provenance,
                                checkpoint_dir=checkpoint_dir, faults=faults,
-                               fault_seed=fault_seed, guard=guard))
+                               fault_seed=fault_seed, guard=guard,
+                               telemetry_dir=telemetry_dir))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -154,6 +176,11 @@ if __name__ == "__main__":
     ap.add_argument("--guard", action="store_true",
                     help="wrap the train step in a StepGuard (skip "
                          "non-finite steps, EMA spike detection, rollback)")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="write a JSONL event stream + heartbeat per config "
+                         "under this dir (telemetry/); point the watchdog's "
+                         "--heartbeat at <dir>/<config>/heartbeat.json and "
+                         "render with python -m experiments.obs_report")
     a = ap.parse_args()
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual
@@ -165,4 +192,5 @@ if __name__ == "__main__":
         pin_cpu_virtual()
     main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append,
          checkpoint_dir=a.checkpoint_dir, faults=a.faults,
-         fault_seed=a.fault_seed, guard=a.guard)
+         fault_seed=a.fault_seed, guard=a.guard,
+         telemetry_dir=a.telemetry_dir)

@@ -1,0 +1,204 @@
+"""Render a telemetry run report from a JSONL event stream.
+
+The read side of the telemetry layer (ddl25spring_tpu/telemetry): given a
+run directory (or an events.jsonl path directly), print a human report —
+manifest, per-collective comm volume, step-time percentiles, phase
+breakdown, fault counters, FL round summary, heartbeat status. Pure
+stdlib + the telemetry read helpers; never imports jax, so it runs
+instantly next to (or instead of) a live training process.
+
+Example:
+    python -m experiments.hw1b_llm --cpu --quick --telemetry-dir /tmp/obs
+    python -m experiments.obs_report /tmp/obs/dp1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# Submodule imports keep this report jax-free (the package __init__ is
+# also safe — its comm.py re-exports are lazy — but importing exactly what
+# is used makes the no-jax contract explicit).
+from ddl25spring_tpu.telemetry.events import iter_runs, read_events
+from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
+from ddl25spring_tpu.telemetry.registry import percentile
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{n:,.0f} B"
+        n /= 1024
+    return f"{n:,.1f} GiB"
+
+
+def _section(title: str) -> None:
+    print(f"\n== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def _fmt_num(v) -> str:
+    """Device-derived metrics (loss, accuracy) reach the stream as the
+    strings "nan"/"inf" when non-finite (EventLog keeps the JSONL strict)
+    — exactly the runs this report exists to diagnose, so print them
+    instead of crashing on the float format spec."""
+    return f"{v:.4f}" if isinstance(v, (int, float)) else str(v)
+
+
+def report_run(events: list, heartbeat_path: str = None) -> None:
+    """Print the report for ONE run_id's event list."""
+    by_type = {}
+    for e in events:
+        # .get: non-strict mode keeps parseable-but-typeless lines; the
+        # tolerant reader buckets them under None rather than crashing.
+        by_type.setdefault(e.get("type"), []).append(e)
+
+    manifest = (by_type.get("manifest") or [None])[0]
+    run_end = (by_type.get("run_end") or [None])[-1]
+    steps = by_type.get("step", [])
+    faults = by_type.get("fault", [])
+    rounds = by_type.get("fl_round", [])
+
+    _section("run")
+    print(f"run_id: {events[0].get('run_id')}   events: {len(events)}")
+    if manifest:
+        for k in ("trainer", "platform", "jax_version", "n_devices", "mesh",
+                  "start_step"):
+            if manifest.get(k) is not None:
+                print(f"{k}: {manifest[k]}")
+
+    comm = (manifest or {}).get("comm")
+    if comm:
+        _section("comm volume (static, per step)")
+        print(f"payload: {_fmt_bytes(comm['payload_bytes_per_step'])}   "
+              f"wire/device: "
+              f"{_fmt_bytes(comm['wire_bytes_per_device_per_step'])}")
+        for label, agg in sorted(comm["collectives"].items(),
+                                 key=lambda kv: -kv[1]["payload_bytes"]):
+            print(f"  {label:28s} {agg['op']:12s} axis={agg['axis']}"
+                  f"({agg['axis_size']})  x{agg['calls']:<5d} "
+                  f"payload {_fmt_bytes(agg['payload_bytes']):>12s}  "
+                  f"wire {_fmt_bytes(agg['wire_bytes_per_device']):>12s}")
+
+    if steps:
+        _section("steps")
+        # Per-step seconds from the event stream's (dt_s, steps) deltas —
+        # events are emitted every step_every iterations, so dt_s/steps is
+        # the mean over that window; the distribution is over windows.
+        # Warmup-flagged windows (compile/replay in dt_s) are excluded.
+        dts = [e["dt_s"] / e["steps"] for e in steps
+               if e.get("steps") and not e.get("warmup")]
+        losses = [e["loss"] for e in steps if e.get("loss") is not None]
+        print(f"step events: {len(steps)}   "
+              f"iters {steps[0]['it']}..{steps[-1]['it']}")
+        if losses:
+            print(f"loss: {_fmt_num(losses[0])} -> {_fmt_num(losses[-1])}")
+        if dts:
+            print("step time: " + "  ".join(
+                f"p{q:g}={percentile(dts, q) * 1e3:.1f}ms"
+                for q in (50, 95, 99)) + f"  n={len(dts)} windows")
+
+    if rounds:
+        _section("fl rounds")
+        accs = [r["test_accuracy"] for r in rounds
+                if r.get("test_accuracy") is not None]
+        walls = [r["wall_s"] for r in rounds if r.get("wall_s") is not None]
+        print(f"rounds: {len(rounds)}")
+        if accs:
+            print(f"test accuracy: {_fmt_num(accs[0])} -> "
+                  f"{_fmt_num(accs[-1])}")
+        if walls:
+            print("round time: " + "  ".join(
+                f"p{q:g}={percentile(walls, q):.3f}s" for q in (50, 95, 99)))
+
+    metrics = (run_end or {}).get("metrics") or {}
+    phase = {k: v for k, v in metrics.get("gauges", {}).items()
+             if k.startswith("phase/") and k.endswith("_s")}
+    if phase:
+        _section("phase breakdown")
+        total = sum(phase.values())
+        for k, v in sorted(phase.items(), key=lambda kv: -kv[1]):
+            name = k[len("phase/"):-len("_s")]
+            pct = 100 * v / total if total else 0
+            print(f"  {name:12s} {v:10.3f}s  {pct:5.1f}%")
+
+    counters = {k: v for k, v in metrics.get("counters", {}).items()
+                if k.startswith("faults/") and v}
+    if faults or counters:
+        _section("faults")
+        for e in faults:
+            print(f"  it {e.get('it', e.get('round', '?')):>6}: "
+                  f"{e['counters']}")
+        if counters:
+            print(f"  totals: "
+                  f"{ {k[len('faults/'):]: int(v) for k, v in counters.items()} }")
+    elif run_end:
+        print("\nfaults: none recorded")
+
+    hists = metrics.get("histograms", {})
+    if hists:
+        _section("metrics (run_end snapshot)")
+        for name, h in sorted(hists.items()):
+            print(f"  {name:16s} n={h['count']:<6d} mean={h['mean']:.4g}  "
+                  f"p50={h['p50']:.4g}  p95={h['p95']:.4g}  "
+                  f"p99={h['p99']:.4g}  max={h['max']:.4g}")
+
+    if run_end:
+        _section("run end")
+        for k in ("steps", "preempted", "tokens_per_sec", "wall_s",
+                  "final_accuracy"):
+            if run_end.get(k) is not None:
+                print(f"{k}: {run_end[k]}")
+    else:
+        print("\nNO run_end event — the run is live, was killed, or "
+              "crashed mid-stream.")
+
+    if heartbeat_path:
+        hb = read_heartbeat(heartbeat_path)
+        _section("heartbeat")
+        if hb is None:
+            print("no readable heartbeat")
+        else:
+            age = time.time() - hb.get("time", 0)
+            print(f"pid {hb.get('pid')}  step {hb.get('step')}  "
+                  f"seq {hb.get('seq')}  phase {hb.get('phase', '-')}  "
+                  f"age {age:.1f}s")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="telemetry run dir (containing "
+                                 "events.jsonl) or an events.jsonl path")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on malformed/invalid events instead of "
+                         "skipping them")
+    a = ap.parse_args(argv)
+
+    if os.path.isdir(a.path):
+        events_path = os.path.join(a.path, "events.jsonl")
+        heartbeat_path = os.path.join(a.path, "heartbeat.json")
+        if not os.path.exists(heartbeat_path):
+            heartbeat_path = None
+    else:
+        events_path = a.path
+        heartbeat_path = None
+    if not os.path.exists(events_path):
+        print(f"no event stream at {events_path}", file=sys.stderr)
+        return 2
+    events = read_events(events_path, strict=a.strict)
+    if not events:
+        print(f"{events_path}: empty event stream", file=sys.stderr)
+        return 2
+    # The heartbeat file belongs to the LATEST writer — attaching it to
+    # every run in a multi-run stream (relaunches share the dir) would
+    # make dead runs look alive.
+    runs = list(iter_runs(events))
+    for i, run in enumerate(runs):
+        report_run(run, heartbeat_path if i == len(runs) - 1 else None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

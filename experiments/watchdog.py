@@ -10,6 +10,14 @@ process and relaunch — the run resumes from its orbax checkpoint and
 re-streams only the lost tail. On success, duplicate rows from retried
 segments are deduped in place.
 
+Liveness signals (LivenessMonitor): progress-file growth, and — when the
+run writes a telemetry heartbeat (``--heartbeat``, telemetry/heartbeat.py)
+— the heartbeat's monotonic ``seq`` advancing. The heartbeat is the
+FIRST-CLASS signal: it beats every iteration, where the CSV only grows per
+sink interval (and not at all for runs without a loss sink), so a healthy
+run between sink rows no longer looks stalled. Either signal moving counts
+as alive; a new pid in the heartbeat also counts (a relaunch IS life).
+
 Relaunches back off exponentially with deterministic jitter
 (ddl25spring_tpu/resilience/retry.py), and crash-loops are distinguished
 from stalls: a process that exits nonzero within ``--crash-window`` seconds
@@ -42,6 +50,47 @@ def file_size(path: str) -> int:
         return -1
 
 
+class LivenessMonitor:
+    """Combined stall detector: progress-file growth OR heartbeat advance.
+
+    ``poll()`` returns True when ANY enabled signal moved since the previous
+    poll. The heartbeat signal is ``(pid, seq)`` — seq is the writer's
+    monotonic beat counter, and pairing it with pid makes a relaunched
+    writer (whose seq restarts at 1, possibly colliding with an old value)
+    register as movement. A missing/torn heartbeat file reads as "no
+    signal" (telemetry.heartbeat.read_heartbeat), never as an error — the
+    progress file then carries liveness alone, which is exactly the
+    pre-heartbeat behavior.
+    """
+
+    def __init__(self, progress_path: str,
+                 heartbeat_path: "str | None" = None):
+        self.progress_path = progress_path
+        self.heartbeat_path = heartbeat_path
+        self._size = file_size(progress_path)
+        self._beat = self._read_beat()
+
+    def _read_beat(self):
+        if not self.heartbeat_path:
+            return None
+        # Direct module import: heartbeat.py is stdlib-only, keeping the
+        # watchdog process jax-free (the package __init__'s jax-touching
+        # comm re-exports are lazy, but this makes the contract explicit).
+        from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
+        hb = read_heartbeat(self.heartbeat_path)
+        return None if hb is None else (hb.get("pid"), hb["seq"])
+
+    def poll(self) -> bool:
+        moved = False
+        size = file_size(self.progress_path)
+        if size != self._size:
+            self._size, moved = size, True
+        beat = self._read_beat()
+        if beat is not None and beat != self._beat:
+            self._beat, moved = beat, True
+        return moved
+
+
 EXIT_GAVE_UP = 1      # burned --max-restarts on stalls/slow failures
 EXIT_CRASH_LOOP = 3   # consecutive immediate exits: relaunching won't help
 
@@ -50,9 +99,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--progress", required=True,
                     help="file whose growth proves the run is alive")
+    ap.add_argument("--heartbeat", default=None,
+                    help="telemetry heartbeat file (heartbeat.json) — its "
+                         "seq advancing also proves liveness, at per-"
+                         "iteration rather than per-sink-row granularity")
     ap.add_argument("--stall-min", type=float, default=12.0,
                     help="kill+relaunch after this many minutes without "
-                         "progress-file growth")
+                         "progress-file growth or heartbeat advance")
     ap.add_argument("--max-restarts", type=int, default=30)
     ap.add_argument("--backoff-base", type=float, default=5.0,
                     help="seconds before the first relaunch; doubles per "
@@ -87,7 +140,7 @@ def main() -> int:
         print(f"[watchdog] attempt {attempt}: {' '.join(cmd)}", flush=True)
         launched = time.time()
         proc = subprocess.Popen(cmd)
-        last_size = file_size(a.progress)
+        monitor = LivenessMonitor(a.progress, a.heartbeat)
         last_change = time.time()
         progressed = False
         while True:
@@ -96,13 +149,14 @@ def main() -> int:
                 break
             except subprocess.TimeoutExpired:
                 pass
-            size = file_size(a.progress)
-            if size != last_size:
-                last_size, last_change = size, time.time()
+            if monitor.poll():
+                last_change = time.time()
                 progressed = True
             elif time.time() - last_change > a.stall_min * 60:
-                print(f"[watchdog] no growth of {a.progress} for "
-                      f"{a.stall_min} min — killing pid {proc.pid}",
+                print(f"[watchdog] no growth of {a.progress}"
+                      + (f" and no heartbeat in {a.heartbeat}"
+                         if a.heartbeat else "")
+                      + f" for {a.stall_min} min — killing pid {proc.pid}",
                       flush=True)
                 proc.kill()
                 proc.wait()

@@ -1,0 +1,566 @@
+"""Telemetry layer (ddl25spring_tpu/telemetry) + observability satellites.
+
+Pins the ISSUE-2 contracts: event-schema round-trip (incl. torn-final-line
+crash tolerance and concurrent writers), EXACT static comm-volume bytes for
+known DP configs (fp32 vs the compressed wire formats), heartbeat-based
+stall detection in the watchdog's LivenessMonitor, cost_analysis guard
+behavior on this jaxlib, thread-safe ResultSink header widening,
+ResilienceStats.merge field completeness, and StepTimer misuse raising.
+"""
+
+import dataclasses
+import json
+import math
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+from ddl25spring_tpu.metrics import ResilienceStats
+from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.parallel import compress, dp, make_mesh
+from ddl25spring_tpu.telemetry import (EventLog, Heartbeat, MetricsRegistry,
+                                       SCHEMA_VERSION, Telemetry,
+                                       flops_crosscheck, hlo_cost,
+                                       measure_comm, read_events,
+                                       read_heartbeat, validate_event)
+from ddl25spring_tpu.tokenizers import ByteTokenizer
+from ddl25spring_tpu.utils.tracing import ResultSink, StepTimer
+
+TINY = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                   ctx_size=16)
+
+
+# ----------------------------------------------------------- event stream
+
+def test_eventlog_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r1") as log:
+        log.manifest(jax_version=jax.__version__, platform="cpu")
+        log.step(it=0, loss=2.5, dt_s=0.1)
+        log.fault(counters={"skipped_steps": 1}, it=3)
+        log.fl_round(round=0, wall_s=0.2, test_accuracy=0.5)
+        log.run_end(steps=10, metrics={"counters": {}})
+    events = read_events(path, strict=True)  # strict: validates every event
+    assert [e["type"] for e in events] == [
+        "manifest", "step", "fault", "fl_round", "run_end"]
+    assert [e["seq"] for e in events] == [1, 2, 3, 4, 5]
+    assert all(e["run_id"] == "r1" for e in events)
+    assert all(e["schema"] == SCHEMA_VERSION for e in events)
+    assert events[1]["loss"] == 2.5 and events[1]["it"] == 0
+    # type filter
+    assert [e["it"] for e in read_events(path, types=("step",))] == [0]
+
+
+def test_eventlog_torn_final_line_and_corruption(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r1") as log:
+        log.step(it=0, loss=1.0)
+        log.step(it=1, loss=2.0)
+    with open(path, "ab") as f:
+        f.write(b'{"schema": 1, "run_id": "r1", "seq": 3, "t": 0, "ty')
+    # A torn FINAL line is a crash artifact, dropped even under strict.
+    assert [e["it"] for e in read_events(path, strict=True)] == [0, 1]
+    # Mid-file garbage is corruption: skipped lax, raised strict.
+    with open(path, "ab") as f:
+        f.write(b'rbage\n')
+        f.write(json.dumps({"schema": 1, "run_id": "r1", "seq": 4, "t": 0,
+                            "type": "step", "it": 2}).encode() + b"\n")
+    assert [e["it"] for e in read_events(path)] == [0, 1, 2]
+    with pytest.raises(ValueError):
+        read_events(path, strict=True)
+    # Valid JSON that is not an object (`null`, a number) is the same
+    # corruption class: skipped lax (with a types filter too), raised
+    # strict — never leaked to crash a consumer's `.get`.
+    path2 = str(tmp_path / "nondict.jsonl")
+    with open(path2, "w") as f:
+        f.write('null\n')
+        f.write(json.dumps({"schema": 1, "run_id": "r", "seq": 1, "t": 0,
+                            "type": "step", "it": 0}) + "\n")
+    assert [e["it"] for e in read_events(path2, types=("step",))] == [0]
+    with pytest.raises(ValueError):
+        read_events(path2, strict=True)
+
+
+def test_eventlog_reopen_heals_torn_fragment(tmp_path):
+    """A relaunch reusing the telemetry dir truncates a crashed
+    predecessor's torn final line instead of appending onto it — the
+    fragment must not become mid-file corruption that strict readers
+    raise on."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r1") as log:
+        log.step(it=0, loss=1.0)
+    with open(path, "ab") as f:
+        f.write(b'{"schema": 1, "run_id": "r1", "seq": 2, "t": 0, "ty')
+    with EventLog(path, run_id="r2") as log:
+        log.manifest(jax_version="test", platform="cpu")
+    events = read_events(path, strict=True)
+    assert [e["run_id"] for e in events] == ["r1", "r2"]
+
+
+def test_eventlog_emit_never_raises(tmp_path):
+    """IO failure drops the event and counts (same never-sink-a-trainer
+    policy as Heartbeat.beat) — including emits after close()."""
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, run_id="r1")
+    log.step(it=0, loss=1.0)
+    log.close()
+    record = log.emit("step", it=1, loss=2.0)   # must not raise
+    assert record["it"] == 1 and log.write_errors == 1
+    assert [e["it"] for e in read_events(path)] == [0]
+    # Serialization failures count too: _json_fallback can't save
+    # non-string dict keys, and json.dumps' TypeError must not escape.
+    log2 = EventLog(path, run_id="r2")
+    log2.emit("custom", data={(0, 1): "tuple-keyed"})
+    assert log2.write_errors == 1
+    log2.step(it=2, loss=3.0)                   # stream still usable
+    log2.close()
+    assert [e["it"] for e in read_events(path, strict=True)] == [0, 2]
+
+
+def test_eventlog_heal_scans_backwards_across_chunks(tmp_path):
+    """The reopen-heal finds the last newline by scanning backwards in
+    64 KiB chunks — a fragment longer than one chunk (a crash mid-way
+    through a huge manifest) must still truncate to the right offset."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r1") as log:
+        log.step(it=0, loss=1.0)
+    with open(path, "ab") as f:
+        f.write(b'{"pad": "' + b"x" * (200 * 1024))  # 200 KiB torn line
+    with EventLog(path, run_id="r2") as log:
+        log.step(it=1, loss=2.0)
+    assert [e["it"] for e in read_events(path, strict=True)] == [0, 1]
+
+
+def test_eventlog_partial_write_seals_torn_tail(tmp_path, monkeypatch):
+    """ENOSPC mid-line: os.write lands SOME bytes then fails. The failed
+    event counts as a write error, and the next successful emit seals the
+    fragment with a newline so it stays ONE skippable malformed line
+    instead of merging into (and corrupting) the next event."""
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, run_id="r1")
+    log.step(it=0, loss=1.0)
+
+    real_write = os.write
+    calls = []
+
+    # POSIX write(2) semantics for a disk filling mid-line: the first call
+    # writes what fits and returns SHORT; the retry gets ENOSPC.
+    def short_then_fail(fd, data):
+        if fd == log._fd:
+            calls.append(len(data))
+            if len(calls) == 1:
+                return real_write(fd, bytes(data)[:10])
+            raise OSError(28, "No space left on device")
+        return real_write(fd, data)
+
+    monkeypatch.setattr(os, "write", short_then_fail)
+    log.step(it=1, loss=2.0)                  # partially lands, counted
+    monkeypatch.setattr(os, "write", real_write)
+    log.step(it=2, loss=3.0)                  # must seal, then append
+    log.close()
+    assert log.write_errors == 1
+    assert [e["it"] for e in read_events(path)] == [0, 2]
+    with pytest.raises(ValueError):           # the fragment IS corruption
+        read_events(path, strict=True)
+
+
+def test_eventlog_nonfinite_floats_stay_strict_json(tmp_path):
+    """An unguarded chaos run can hand emit() loss=nan — the stream must
+    stay STRICT JSON (the CI artifact is consumed by jq/non-Python
+    readers), so non-finite floats land as their str(), never as the
+    NaN/Infinity tokens json.dumps writes by default."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r1") as log:
+        log.step(it=0, loss=float("nan"),
+                 extra=[float("inf"), np.float32("nan")])
+    raw = open(path).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    assert log.write_errors == 0
+    (event,) = read_events(path, strict=True)
+    assert event["loss"] == "nan" and event["extra"][0] == "inf"
+
+
+def test_telemetry_step_every_floor(tmp_path):
+    """step_every=0 ('disable step events') must not arm a
+    ZeroDivisionError inside the training loop's `it % step_every`."""
+    tel = Telemetry(str(tmp_path / "run"), step_every=0)
+    assert tel.step_every == 1
+    tel.close()
+
+
+def test_validate_event_contract():
+    base = {"schema": SCHEMA_VERSION, "run_id": "r", "seq": 1, "t": 0.0}
+    assert validate_event({**base, "type": "step", "it": 3}) == []
+    # Per-type required fields.
+    assert validate_event({**base, "type": "step"}) != []
+    # Unknown types are forward-compatible, not errors.
+    assert validate_event({**base, "type": "novel_event"}) == []
+    # A FUTURE schema version is a problem; missing base fields are too.
+    assert validate_event({**base, "schema": SCHEMA_VERSION + 1,
+                           "type": "step", "it": 0}) != []
+    assert validate_event({"type": "step", "it": 0}) != []
+
+
+def test_eventlog_concurrent_writers(tmp_path):
+    """10 threads x 50 events through one log: every event lands intact
+    (one write() under the lock), seq is a permutation of 1..500."""
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, run_id="r1")
+
+    def emit(tid):
+        for i in range(50):
+            log.emit("step", it=i, thread=tid)
+
+    threads = [threading.Thread(target=emit, args=(t,)) for t in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    events = read_events(path, strict=True)
+    assert len(events) == 500
+    assert sorted(e["seq"] for e in events) == list(range(1, 501))
+
+
+# ------------------------------------------------- comm-volume accounting
+
+def _param_bytes(params, itemsize):
+    return sum(math.prod(l.shape) for l in jax.tree.leaves(params)) * itemsize
+
+
+def test_comm_exact_bytes_dp_fp32(devices):
+    """The known-config contract: a data=2 DP gradient-aggregation step
+    moves EXACTLY n_params fp32 elements through grad_allreduce plus one
+    scalar loss, with ring wire factor 2*(n-1)/n = 1.0 at n=2."""
+    n = 2
+    mesh = make_mesh({"data": n}, devices=devices[:n])
+    params = llama.init_llama(jax.random.key(0), TINY)
+    opt = optax.adam(1e-3)
+    state = dp.replicate(mesh, dp.init_state(params, opt))
+    step = dp.make_grad_aggregation_step(
+        lambda p, b: llama.forward_loss(p, b, TINY), opt, mesh)
+    batch = jax.ShapeDtypeStruct((n * 2, TINY.ctx_size), jnp.int32)
+    profile = measure_comm(step, state, batch)
+    assert profile is not None and profile.records
+    by = profile.by_label()
+    expected = _param_bytes(params, 4)                 # fp32 wire
+    assert by["grad_allreduce"]["payload_bytes"] == expected
+    assert by["grad_allreduce"]["axis_size"] == n
+    assert by["loss_allreduce"]["payload_bytes"] == 4  # one fp32 scalar
+    # Ring allreduce at n=2: 2*(n-1)/n = 1.0 -> wire == payload.
+    assert by["grad_allreduce"]["wire_bytes_per_device"] == expected
+    assert profile.payload_bytes_per_step == expected + 4
+
+
+def test_comm_bf16_wire_halves_payload(devices):
+    """The compression lever the accounting exists to measure: the bf16
+    wire format's grad collective carries exactly HALF the fp32 bytes."""
+    n = 2
+    mesh = make_mesh({"data": n}, devices=devices[:n])
+    params = llama.init_llama(jax.random.key(0), TINY)
+    opt = optax.adam(1e-3)
+    state = dp.replicate(mesh, dp.init_state(params, opt))
+    step = compress.make_bf16_grad_step(
+        lambda p, b: llama.forward_loss(p, b, TINY), opt, mesh)
+    batch = jax.ShapeDtypeStruct((n * 2, TINY.ctx_size), jnp.int32)
+    profile = measure_comm(step, state, batch)
+    by = profile.by_label()
+    assert by["grad_allreduce_bf16"]["payload_bytes"] == _param_bytes(params, 2)
+
+
+def test_comm_scale_multiplies_scan_trips():
+    """A record's ``scale`` (scan trip count) multiplies the per-step
+    aggregate — the mechanism the PP/SP ring call sites rely on."""
+    from ddl25spring_tpu.telemetry.comm import CommProfile, CommRecord
+    r = CommRecord(op="ppermute", label="hop", axis="stage", axis_size=4,
+                   payload_bytes=100, scale=6)
+    p = CommProfile([r])
+    assert p.payload_bytes_per_step == 600
+    assert p.by_label()["hop"]["calls"] == 6
+    assert r.wire_bytes_per_device == 100.0      # one neighbor send per exec
+
+
+def test_measure_comm_handles_cached_trace():
+    """A step whose trace is already cached must still produce records
+    (the one-retry-after-clear_caches path in measure_comm)."""
+    @jax.jit
+    def f(x):
+        from ddl25spring_tpu.telemetry import comm
+        return comm.psum(x, "i", label="row_sum")
+
+    vx = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+
+    def mapped(x):
+        return jax.vmap(f, axis_name="i")(x)
+
+    first = measure_comm(mapped, vx)
+    second = measure_comm(mapped, vx)      # cache-warm path
+    # Accounting is per-participant: the operand INSIDE the mapped axis is
+    # the [4] f32 local row, and the axis resolves to its 8 participants.
+    assert first.by_label()["row_sum"]["payload_bytes"] == 4 * 4
+    assert first.by_label()["row_sum"]["axis_size"] == 8
+    assert second.by_label()["row_sum"]["payload_bytes"] == 4 * 4
+
+
+# ------------------------------------------------------- HLO cost guard
+
+def test_hlo_cost_on_this_jaxlib():
+    """cost_analysis availability guard: on this jax/jaxlib the chain works
+    and a single matmul's count matches 2*M*N*K, so flops_crosscheck
+    reports source='hlo'. If a future jaxlib breaks the API, hlo_cost must
+    degrade to None (and the crosscheck to 'analytic') — both arms are the
+    pinned contract."""
+    m, k, n = 32, 64, 16
+    f = jax.jit(lambda a, b: a @ b)
+    a = jax.ShapeDtypeStruct((m, k), jnp.float32)
+    b = jax.ShapeDtypeStruct((k, n), jnp.float32)
+    hlo = hlo_cost(f, a, b)
+    analytic = 2.0 * m * k * n
+    if hlo is None:  # legal degradation on a drifted jaxlib
+        assert flops_crosscheck(analytic, hlo)["flops_source"] == "analytic"
+        return
+    assert hlo["flops"] > 0
+    check = flops_crosscheck(analytic, hlo)
+    assert check["flops_source"] == "hlo"
+    assert check["rel_err"] < 0.10
+
+
+def test_hlo_cost_unavailable_paths():
+    assert hlo_cost(lambda x: x, 1) is None          # not jitted: no .lower
+    assert flops_crosscheck(100.0, None) == {
+        "flops_source": "analytic", "hlo_flops": None, "rel_err": None}
+    # >10% divergence: the analytic formula stays authoritative.
+    far = flops_crosscheck(100.0, {"flops": 150.0, "bytes_accessed": None})
+    assert far["flops_source"] == "analytic"
+    assert far["rel_err"] == pytest.approx(0.5)
+    near = flops_crosscheck(100.0, {"flops": 105.0, "bytes_accessed": None})
+    assert near["flops_source"] == "hlo"
+
+
+def test_hlo_cost_normalize_variants():
+    from ddl25spring_tpu.telemetry.costs import _normalize
+    assert _normalize([{"flops": 10.0}]) == {"flops": 10.0,
+                                             "bytes_accessed": None}
+    assert _normalize({"flops": 10.0, "bytes accessed": 5.0}) == {
+        "flops": 10.0, "bytes_accessed": 5.0}
+    assert _normalize({"flops": -1}) is None          # some backends' "n/a"
+    assert _normalize(None) is None
+    assert _normalize([]) is None
+
+
+# -------------------------------------------- heartbeat + watchdog stall
+
+def test_heartbeat_roundtrip_and_seq(tmp_path):
+    path = str(tmp_path / "heartbeat.json")
+    hb = Heartbeat(path)
+    assert hb.beat(step=3)
+    assert hb.beat(step=4, phase="train")
+    got = read_heartbeat(path)
+    assert got["step"] == 4 and got["seq"] == 2 and got["phase"] == "train"
+    assert got["pid"] == os.getpid()
+    # Unreadable/missing/torn files degrade to None, never raise.
+    assert read_heartbeat(str(tmp_path / "missing.json")) is None
+    with open(path, "w") as f:
+        f.write('{"torn')
+    assert read_heartbeat(path) is None
+
+
+def test_liveness_monitor_heartbeat_stall_detection(tmp_path):
+    """The watchdog's first-class heartbeat signal: seq advancing proves
+    life with zero progress-file growth; neither signal moving is a stall;
+    a NEW WRITER (pid change, seq restart) is life, not a stall."""
+    from experiments.watchdog import LivenessMonitor
+    progress = tmp_path / "progress.csv"
+    progress.write_text("iter,loss\n")
+    hb_path = str(tmp_path / "heartbeat.json")
+    hb = Heartbeat(hb_path)
+    hb.beat(step=0)
+
+    mon = LivenessMonitor(str(progress), hb_path)
+    assert mon.poll() is False                  # nothing moved since init
+    hb.beat(step=1)                             # heartbeat only, no CSV row
+    assert mon.poll() is True
+    assert mon.poll() is False                  # stalled again
+    progress.write_text("iter,loss\n0,2.5\n")   # CSV only, no beat
+    assert mon.poll() is True
+    # Relaunch: a fresh writer's seq restarts at 1 with a different pid —
+    # that must register as movement even though 1 < the old seq.
+    with open(hb_path, "w") as f:
+        json.dump({"schema": 1, "pid": os.getpid() + 1, "step": 0, "seq": 1,
+                   "time": 0.0, "monotonic": 0.0}, f)
+    assert mon.poll() is True
+    # Heartbeat file vanishing is "no signal", not movement.
+    os.unlink(hb_path)
+    assert mon.poll() is False
+
+
+def test_liveness_monitor_without_heartbeat(tmp_path):
+    """No --heartbeat: exactly the legacy growth-only behavior."""
+    from experiments.watchdog import LivenessMonitor
+    progress = tmp_path / "progress.csv"
+    mon = LivenessMonitor(str(progress))        # file doesn't exist yet
+    assert mon.poll() is False
+    progress.write_text("a\n")
+    assert mon.poll() is True
+    assert mon.poll() is False
+
+
+# ----------------------------------------------------- metrics registry
+
+def test_registry_percentiles_and_snapshot():
+    reg = MetricsRegistry()
+    for v in range(1, 101):                     # 1..100
+        reg.observe("t", float(v))
+    pcts = reg.percentiles("t")
+    assert pcts["p50"] == pytest.approx(50.5)
+    assert pcts["p95"] == pytest.approx(95.05)
+    assert pcts["p99"] == pytest.approx(99.01)
+    reg.counter_inc("n", 2)
+    reg.gauge_set("g", 7.0)
+    with pytest.raises(ValueError):
+        reg.counter_inc("n", -1)
+    snap = reg.snapshot()
+    assert snap["counters"]["n"] == 2.0 and snap["gauges"]["g"] == 7.0
+    h = snap["histograms"]["t"]
+    assert h["count"] == 100 and h["max"] == 100.0
+    assert reg.percentiles("missing") == {}
+
+
+def test_registry_absorbs_resilience_completely():
+    """The adapter iterates the stats object's own fields: EVERY counter —
+    including any future one — lands in the registry."""
+    reg = MetricsRegistry()
+    stats = ResilienceStats(skipped_steps=2, preemptions=1)
+    reg.absorb_resilience(stats)
+    for name in stats.as_dict():
+        assert reg.counter(f"faults/{name}") == getattr(stats, name)
+
+
+def test_resilience_stats_merge_field_completeness():
+    """A newly added counter must not be silently dropped by merge/as_dict:
+    both walk the dataclass's own fields, pinned here field-by-field."""
+    fields = [f.name for f in dataclasses.fields(ResilienceStats)]
+    a = ResilienceStats(**{f: i + 1 for i, f in enumerate(fields)})
+    b = ResilienceStats(**{f: 100 * (i + 1) for i, f in enumerate(fields)})
+    a.merge(b)
+    for i, f in enumerate(fields):
+        assert getattr(a, f) == 101 * (i + 1), f"merge dropped {f!r}"
+    assert set(a.as_dict()) == set(fields)
+    assert a.total_faults_handled == sum(101 * (i + 1)
+                                         for i in range(len(fields)))
+    # delta walks the same fields: every moved counter appears, none else.
+    assert a.delta(b.as_dict()) == {f: i + 1
+                                    for i, f in enumerate(fields)}
+    assert a.delta(a.as_dict()) == {}
+
+
+# ------------------------------------------------- tracing satellites
+
+def test_step_timer_tick_before_start_raises():
+    t = StepTimer()
+    with pytest.raises(RuntimeError):
+        t.tick()
+    t.start()
+    assert t.tick() >= 0.0 and len(t.times) == 1
+
+
+def test_resultsink_concurrent_header_widening(tmp_path):
+    """8 threads append records with PROGRESSIVELY WIDER field sets into one
+    sink: no row may be lost to a widening rewrite racing an append, and
+    the final header must be the union of all fields."""
+    path = str(tmp_path / "out.csv")
+    sink = ResultSink(path)
+
+    def writer(tid):
+        for i in range(25):
+            row = {"iter": i, "thread": tid}
+            if i >= 10:
+                row[f"extra_{tid}"] = i       # per-thread widening field
+            sink.write(row)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    import csv as _csv
+    with open(path, newline="") as f:
+        rows = list(_csv.DictReader(f))
+    assert len(rows) == 8 * 25                     # zero rows dropped
+    header = rows[0].keys()
+    assert {"iter", "thread", *{f"extra_{t}" for t in range(8)}} <= set(header)
+    for t in range(8):                             # every thread's tail rows
+        tail = [r for r in rows
+                if r["thread"] == str(t) and r[f"extra_{t}"] != ""]
+        assert len(tail) == 15
+
+
+# ------------------------------------------------- end-to-end integration
+
+def test_trainer_telemetry_end_to_end(tmp_path, devices):
+    """train_llm_dp with a Telemetry attached: valid JSONL stream (manifest
+    with EXACT static comm bytes, step cadence, run_end snapshot) plus a
+    live heartbeat — the acceptance flow obs_report renders."""
+    n = 2
+    with Telemetry(str(tmp_path / "run"), step_every=2) as tel:
+        from ddl25spring_tpu.train.llm import train_llm_dp
+        report = train_llm_dp(
+            model_cfg=TINY,
+            train_cfg=TrainConfig(batch_size=2, seq_len=16, iters=5,
+                                  lr=3e-3, data=n),
+            mesh=make_mesh({"data": n}, devices=devices[:n]),
+            tokenizer=ByteTokenizer(), log_every=0, telemetry=tel)
+        events = read_events(tel.events_path, strict=True)
+    by_type = {}
+    for e in events:
+        by_type.setdefault(e["type"], []).append(e)
+    manifest = by_type["manifest"][0]
+    assert manifest["trainer"] == "dp" and manifest["mesh"] == {"data": n}
+    params = llama.init_llama(jax.random.key(0), TINY)
+    comm = manifest["comm"]["collectives"]
+    assert comm["grad_allreduce"]["payload_bytes"] == _param_bytes(params, 4)
+    assert [e["it"] for e in by_type["step"]] == [0, 2, 4]
+    run_end = by_type["run_end"][0]
+    assert run_end["steps"] == report.steps == 5
+    snap = run_end["metrics"]
+    assert snap["histograms"]["host_iter_s"]["count"] == 5
+    assert snap["gauges"]["phase/dispatch_s"] > 0
+    hb = read_heartbeat(tel.heartbeat_path)
+    assert hb["step"] == 5 and hb["phase"] == "done"
+    # The renderer consumes what the trainers emit (acceptance criterion).
+    from experiments.obs_report import main as report_main
+    assert report_main([str(tmp_path / "run")]) == 0
+
+
+def test_fl_server_emits_round_events(tmp_path):
+    """FL servers report through the same stream: one fl_round per round
+    with accuracy/wall/messages, plus manifest and run_end."""
+    from ddl25spring_tpu.config import FLConfig
+    from ddl25spring_tpu.data import mnist
+    from ddl25spring_tpu.fl import FedAvgServer, federate
+    from ddl25spring_tpu.models import mnist_cnn
+    x_raw, y, xt_raw, yt = mnist.load_mnist(n_train=300, n_test=100, seed=0)
+    x, xt = mnist.normalize(x_raw), mnist.normalize(xt_raw)
+    cfg = FLConfig(nr_clients=6, client_fraction=0.5, batch_size=50,
+                   epochs=1, lr=0.05, rounds=2, seed=3)
+    data = federate(x, y.astype(np.int32),
+                    mnist.split(y, cfg.nr_clients, iid=True, seed=cfg.seed))
+    with Telemetry(str(tmp_path / "fl")) as tel:
+        server = FedAvgServer(mnist_cnn.init(jax.random.key(0)),
+                              mnist_cnn.apply, data, xt,
+                              yt.astype(np.int32), cfg, telemetry=tel)
+        result = server.run(2)
+        events = read_events(tel.events_path, strict=True)
+    rounds = [e for e in events if e["type"] == "fl_round"]
+    assert [r["round"] for r in rounds] == [0, 1]
+    assert rounds[-1]["test_accuracy"] == result.test_accuracy[-1]
+    assert rounds[-1]["messages"] == result.message_count[-1]
+    end = [e for e in events if e["type"] == "run_end"][-1]
+    assert end["final_accuracy"] == result.test_accuracy[-1]
+    assert read_heartbeat(tel.heartbeat_path)["seq"] == 2
