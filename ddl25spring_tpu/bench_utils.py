@@ -47,7 +47,9 @@ def make_optimizer(opt_name: str, lr: float = 8e-4):
 def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
                     seq: Optional[int] = None, opt_name: str = "fused",
                     wire: Optional[str] = None,
-                    warmup: int = 3, timed_steps: int = 20) -> float:
+                    warmup: int = 3, timed_steps: int = 20,
+                    steps_per_dispatch: int = 1,
+                    aggregation: str = "gradient") -> float:
     """Total tokens/sec of the DP train step at the given per-chip batch.
 
     ``seq`` defaults to ``cfg.ctx_size``. The caller divides by its device
@@ -55,15 +57,28 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
     selects the compressed-allreduce step (parallel/compress.py) — on one
     chip the collective is local, so the measurement is the compression
     math's overhead (quantize + error-feedback), the number VERDICT r4
-    asked for alongside the multi-chip design."""
+    asked for alongside the multi-chip design.
+
+    ``steps_per_dispatch`` = K > 1 times the fused K-step scan driver
+    (parallel/dp.py ``make_multi_step``): the same warmup/timed step budget
+    is spent in ceil-divided windows of K, so the token accounting stays
+    comparable with the per-step rows while the dispatch overhead is paid
+    once per window. ``aggregation`` ∈ {"gradient", "zero1"} picks the
+    plain pmean path or the ZeRO-1 sharded weight update; both compose
+    with ``steps_per_dispatch`` (``make_zero1_multi_step``), neither with
+    ``wire``."""
     seq = seq or cfg.ctx_size
     n_dev = mesh.devices.size
+    K = max(1, int(steps_per_dispatch))
     params = llama.init_llama(jax.random.key(0), cfg)
     opt = make_optimizer(opt_name)
 
     def loss_fn(p, batch):
         return llama.forward_loss(p, batch, cfg)
 
+    if wire is not None and (aggregation != "gradient" or K != 1):
+        raise ValueError("wire compression composes with per-step gradient "
+                         "aggregation only")
     if wire == "bf16":
         from .parallel import compress
         state = dp.replicate(mesh, dp.init_state(params, opt))
@@ -72,15 +87,38 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
         from .parallel import compress
         state = compress.init_ef_state(mesh, params, opt)
         step = compress.make_int8_ef_grad_step(loss_fn, opt, mesh)
-    elif wire is None:
+    elif wire is None and aggregation == "zero1":
+        if K > 1:
+            state, step = dp.make_zero1_multi_step(loss_fn, opt, mesh, params)
+        else:
+            state, step = dp.make_zero1_step(loss_fn, opt, mesh, params)
+    elif wire is None and aggregation == "gradient":
+        if K > 1:
+            step = dp.make_multi_step(loss_fn, opt, mesh)
+        else:
+            step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
         state = dp.replicate(mesh, dp.init_state(params, opt))
-        step = dp.make_grad_aggregation_step(loss_fn, opt, mesh)
     else:
-        raise ValueError(f"unknown wire {wire!r}")
+        raise ValueError(f"unknown wire/aggregation {wire!r}/{aggregation!r}")
     tokens = jax.random.randint(jax.random.key(1), (n_dev * batch_size, seq),
                                 0, cfg.vocab_size)
-    batch = dp.shard_batch(mesh, tokens)
+    if K > 1:
+        window = dp.shard_batch_window(
+            mesh, jnp.broadcast_to(tokens, (K,) + tokens.shape))
+        warm_chunks = max(1, -(-warmup // K))
+        timed_chunks = max(1, -(-timed_steps // K))
+        for _ in range(warm_chunks):
+            state, losses = step(state, window)
+        float(losses[-1])  # hard sync before the timer
+        t0 = time.perf_counter()
+        for _ in range(timed_chunks):
+            state, losses = step(state, window)
+        float(losses[-1])  # forces the whole timed chain
+        dt = time.perf_counter() - t0
+        del state
+        return n_dev * batch_size * seq * timed_chunks * K / dt
 
+    batch = dp.shard_batch(mesh, tokens)
     for _ in range(warmup):
         state, loss = step(state, batch)
     float(loss)  # hard sync before the timer

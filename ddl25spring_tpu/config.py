@@ -152,6 +152,14 @@ class TrainConfig:
     # pmean), "bf16" or "int8_ef" (parallel/compress.py).
     wire: str = "fp32"
     accum_steps: int = 1           # DP gradient accumulation (dp.py)
+    # Fused multi-step dispatch (DP trainer): K > 1 lax.scans K training
+    # steps over a [K, B, T] device-resident batch window in ONE compiled,
+    # donated dispatch (dp.make_multi_step / make_zero1_multi_step) — the
+    # per-step Python dispatch overhead is paid once per window. Loss
+    # trajectory is bit-identical to K=1; host-side work (loss sink,
+    # telemetry step events, checkpoint saves, StepGuard verdicts, preempt
+    # checks) quantizes to chunk edges — see train/llm.py:_run_loop.
+    steps_per_dispatch: int = 1
 
 
 @dataclass(frozen=True)

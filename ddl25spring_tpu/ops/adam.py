@@ -16,6 +16,13 @@ up to float re-association (asserted ≤1e-6 in tests/test_core.py).
 
 Drop-in: ``fused_adam(8e-4)`` anywhere an ``optax.GradientTransformation``
 is accepted (dp/pp/ep steps, train.llm, bench.py).
+
+ZeRO-1 note (parallel/dp.py): Adam is elementwise — the update at
+coordinate i depends only on (g, m, v) at i — so applying it to a 1/N
+slice of the flattened parameter vector commutes with slicing. That is
+the property the sharded weight update relies on for exact equivalence
+with the replicated update, and it holds for every transformation in this
+module.
 """
 
 from __future__ import annotations
@@ -25,6 +32,20 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import optax
+
+
+def apply_optimizer(optimizer, grads, opt_state, params):
+    """One optimizer application: the duck-typed ``apply_gradients`` fast
+    path when the optimizer provides it (ops.pallas_adam.FusedApplyAdam —
+    one fused kernel pass over {p, m, v, g} instead of update + apply),
+    else the plain optax update. Shared by every step factory that
+    consumes averaged gradients (parallel/dp.py — including the ZeRO-1
+    slice update, where the fast path runs on each replica's 1/N shard —
+    and parallel/compress.py)."""
+    if hasattr(optimizer, "apply_gradients"):
+        return optimizer.apply_gradients(params, grads, opt_state)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
 
 
 class FusedAdamState(NamedTuple):

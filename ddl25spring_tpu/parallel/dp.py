@@ -11,11 +11,21 @@ TPU-native shape: the barrier/flatten/all_reduce/unflatten/scale dance
 (intro_DP_GA.py:53-66) collapses to ``lax.pmean(grads, "data")`` inside a
 ``shard_map`` — the collective lowers to one XLA all-reduce over ICI, fused
 with the step. No CPU staging, no sockets, no tags.
+
+Hot-path fusion (the headline-bench lever): ``make_multi_step`` /
+``make_zero1_multi_step`` scan K steps over a device-resident
+``[K, B, T]`` batch window inside ONE compiled, donated dispatch — the
+per-step Python dispatch/donation overhead (dominant on the oversubscribed
+CPU fallback, measurable on accelerators) is paid once per K steps, and the
+per-step loss history comes back as the scan's stacked output instead of K
+host round trips. Semantics are bit-identical to K calls of the per-step
+factory (asserted in tests/test_dp.py). Pattern references: weight-update
+sharding (Xu et al., arxiv 2004.13336) and accumulate-while-you-communicate
+overlap (ACCO, arxiv 2406.02613) — PAPERS.md.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
@@ -24,6 +34,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.adam import apply_optimizer  # noqa: F401  (canonical home moved;
+#                                         re-exported for existing callers)
 from ..telemetry import comm
 from ._compat import shard_map
 
@@ -66,16 +78,67 @@ def sharded_opt_init(mesh: Mesh, params, optimizer: optax.GradientTransformation
     return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
 
 
-def apply_optimizer(optimizer, grads, opt_state, params):
-    """One optimizer application: the duck-typed ``apply_gradients`` fast
-    path when the optimizer provides it (ops.pallas_adam.FusedApplyAdam —
-    one fused kernel pass over {p, m, v, g} instead of update + apply),
-    else the plain optax update. Shared by every step factory that
-    consumes averaged gradients (here and parallel/compress.py)."""
-    if hasattr(optimizer, "apply_gradients"):
-        return optimizer.apply_gradients(params, grads, opt_state)
-    updates, opt_state = optimizer.update(grads, opt_state, params)
-    return optax.apply_updates(params, updates), opt_state
+def _make_local_grad_step(loss_fn: Callable, optimizer, accum_steps: int,
+                          guard_nonfinite: bool, comm_scale: int = 1
+                          ) -> Callable:
+    """The per-shard gradient-aggregation step body shared by the per-step
+    factory (``make_grad_aggregation_step``) and the K-step scan driver
+    (``make_multi_step``) — one implementation, so the two cannot drift.
+
+    ``comm_scale`` is the telemetry execution multiplier: inside a
+    ``lax.scan`` body the collectives trace once but run ``K`` times per
+    dispatch, and the comm wrappers record that trip count so the static
+    wire-byte profile stays exact (telemetry/comm.py ``scale``)."""
+
+    def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        else:
+            micro = batch.reshape((accum_steps, -1) + batch.shape[1:])
+
+            def body(carry, mb):
+                loss_sum, gsum = carry
+                l, g = jax.value_and_grad(loss_fn)(state.params, mb)
+                # Accumulate in fp32 regardless of param/grad dtype: a bf16
+                # running sum would round away small microbatch
+                # contributions (the vanishing-accumulation failure mode
+                # ops/mixed_precision.py exists to fix).
+                gsum = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
+                return (loss_sum + l.astype(jnp.float32), gsum), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss, gsum), _ = lax.scan(body, (jnp.zeros(()), zeros), micro)
+            loss = loss / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                gsum, state.params)
+        # The one payload collective per iter (telemetry.comm wrappers are
+        # lax pass-throughs that record bytes at trace time — see
+        # telemetry/comm.py; compiled HLO is unchanged).
+        grads = comm.pmean(grads, "data", label="grad_allreduce",
+                           scale=comm_scale)
+        loss = comm.pmean(loss, "data", label="loss_allreduce",
+                          scale=comm_scale)
+        params, opt_state = apply_optimizer(optimizer, grads,
+                                            state.opt_state, state.params)
+        if guard_nonfinite:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                ok &= jnp.all(jnp.isfinite(g))
+            # Select-back, not zeroed grads: a zero-grad optimizer update
+            # still decays Adam moments and bumps count — only keeping the
+            # incoming state makes the skip a true no-op.
+            params = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                  params, state.params)
+            opt_state = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                     opt_state, state.opt_state)
+            return TrainState(params, opt_state,
+                              state.step + ok.astype(state.step.dtype)), loss
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return local_step
 
 
 def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
@@ -107,59 +170,53 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
     The host-side StepGuard (resilience/guard.py) layers EMA anomaly
     detection and checkpoint rollback on top when those are wanted.
     """
-
-    def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
-        if accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        else:
-            micro = batch.reshape((accum_steps, -1) + batch.shape[1:])
-
-            def body(carry, mb):
-                loss_sum, gsum = carry
-                l, g = jax.value_and_grad(loss_fn)(state.params, mb)
-                # Accumulate in fp32 regardless of param/grad dtype: a bf16
-                # running sum would round away small microbatch
-                # contributions (the vanishing-accumulation failure mode
-                # ops/mixed_precision.py exists to fix).
-                gsum = jax.tree.map(
-                    lambda a, b: a + b.astype(jnp.float32), gsum, g)
-                return (loss_sum + l.astype(jnp.float32), gsum), None
-
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (loss, gsum), _ = lax.scan(body, (jnp.zeros(()), zeros), micro)
-            loss = loss / accum_steps
-            grads = jax.tree.map(
-                lambda g, p: (g / accum_steps).astype(p.dtype),
-                gsum, state.params)
-        # The one payload collective per iter (telemetry.comm wrappers are
-        # lax pass-throughs that record bytes at trace time — see
-        # telemetry/comm.py; compiled HLO is unchanged).
-        grads = comm.pmean(grads, "data", label="grad_allreduce")
-        loss = comm.pmean(loss, "data", label="loss_allreduce")
-        params, opt_state = apply_optimizer(optimizer, grads,
-                                            state.opt_state, state.params)
-        if guard_nonfinite:
-            ok = jnp.isfinite(loss)
-            for g in jax.tree.leaves(grads):
-                ok &= jnp.all(jnp.isfinite(g))
-            # Select-back, not zeroed grads: a zero-grad optimizer update
-            # still decays Adam moments and bumps count — only keeping the
-            # incoming state makes the skip a true no-op.
-            params = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
-                                  params, state.params)
-            opt_state = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
-                                     opt_state, state.opt_state)
-            return TrainState(params, opt_state,
-                              state.step + ok.astype(state.step.dtype)), loss
-        return TrainState(params, opt_state, state.step + 1), loss
-
+    local_step = _make_local_grad_step(loss_fn, optimizer, accum_steps,
+                                       guard_nonfinite)
     sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P("data")),
         out_specs=(P(), P()),
         check_vma=False,  # optax state carries non-vma-tracked leaves
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_multi_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, accum_steps: int = 1,
+                    guard_nonfinite: bool = False) -> Callable:
+    """Fused K-step driver: ``step(state, window) -> (state, losses)`` where
+    ``window`` is a device-resident ``[K, n_shards·B, T]`` batch window
+    (leading axis = consecutive training steps, second axis sharded over
+    ``data`` — ``shard_batch_window``) and ``losses`` is the ``[K]``
+    per-step loss sequence from the scan's stacked outputs.
+
+    One compiled, donated dispatch runs all K steps: Python dispatch,
+    donation bookkeeping and the host round trip are paid once per window
+    instead of once per step. The scanned body IS
+    ``make_grad_aggregation_step``'s body (shared ``_make_local_grad_step``),
+    so the loss sequence and final state are bit-identical to K per-step
+    calls (asserted in tests/test_dp.py at K∈{1,4}), and per-step wire
+    bytes are unchanged — the comm profile records the same collectives at
+    ``scale=K`` per dispatch.
+
+    K is read from the window's static leading dim at trace time, so ONE
+    returned callable serves every chunk size (a tail chunk of k < K steps
+    just triggers one more compile for that shape).
+    """
+
+    def multi(state: TrainState, window):
+        local_step = _make_local_grad_step(loss_fn, optimizer, accum_steps,
+                                           guard_nonfinite,
+                                           comm_scale=window.shape[0])
+        return lax.scan(local_step, state, window)
+
+    sharded = shard_map(
+        multi,
+        mesh=mesh,
+        in_specs=(P(), P(None, "data")),
+        out_specs=(P(), P()),
+        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
@@ -193,34 +250,13 @@ def make_weight_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTra
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
-                    mesh: Mesh, params) -> Tuple[TrainState, Callable]:
-    """ZeRO-1 data parallelism: optimizer state sharded across the ``data``
-    axis (parity-plus — SURVEY.md §2.10 marks ZeRO/FSDP absent in the
-    reference; pattern reference: "Automatic Cross-Replica Sharding of
-    Weight Update in Data-Parallel Training", arxiv 2004.13336, PAPERS.md).
-
-    Per step, on each shard: local grads → ``lax.psum_scatter`` (averaged
-    1/n-th of the flattened gradient, half the allreduce's wire volume for
-    this leg) → optimizer update on the LOCAL moment slice only →
-    ``lax.all_gather`` of the updated parameter slice. Params stay
-    replicated; Adam's mu/nu shrink to 1/n per device — the memory that
-    caps model size under plain DP.
-
-    Exact-equivalence caveat: valid for elementwise optimizers (sgd, adam,
-    adamw, ...) whose update at coordinate i depends only on history at i —
-    slicing commutes with the update rule, so the result is bit-comparable
-    to ``make_grad_aggregation_step`` (asserted in tests/test_dp.py).
-
-    Returns ``(state, step_fn)`` — the initial TrainState with sharded
-    moments, and ``step_fn(state, batch) -> (state, loss)``.
-
-    Transient-memory note: each step ravels the replicated params/grads into
-    one padded fp32 vector before the scatter — a ~2·|params| fp32 transient
-    per device. The *persistent* saving (moments at 1/n, the 2/3 of Adam
-    state that caps model size) is what ZeRO-1 is for; a fully flat-resident
-    params layout would trade API simplicity for removing the transient.
-    """
+def _zero1_setup(optimizer, mesh: Mesh, params):
+    """Shared ZeRO-1 initialization: the padded flat-vector geometry, the
+    local-slice optimizer PartitionSpecs, and the initial TrainState with
+    moments sharded over ``data`` (each shard owns the moments of its 1/n
+    slice — the ``sharded_opt_init`` placement idea taken one step further,
+    from "moments on the right devices" to "each device holds only its
+    slice"). Returns ``(state, opt_specs, n, pad, local, total)``."""
     from ..utils import pytree as pt
 
     n = mesh.shape["data"]
@@ -250,33 +286,139 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     state = TrainState(replicate(mesh, params), opt_state,
                        jax.device_put(jnp.zeros((), jnp.int32),
                                       NamedSharding(mesh, P())))
+    return state, opt_specs, n, pad, local, total
+
+
+def _make_zero1_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
+                           local: int, total: int, *,
+                           guard_nonfinite: bool = False,
+                           comm_scale: int = 1) -> Callable:
+    """The per-shard ZeRO-1 step body shared by ``make_zero1_step`` and
+    ``make_zero1_multi_step``: local grads → reduce-scatter (each shard
+    receives the averaged 1/n-th of the flat gradient) → optimizer update on
+    the LOCAL slice only → all-gather of the fresh parameter slices.
+
+    ``guard_nonfinite`` needs one extra (4-byte) collective here, unlike the
+    replicated path: a NaN in shard j's gradient contribution lands only in
+    the slice coordinates whose owner summed it, so the finiteness verdict
+    is per-shard and must be psum-agreed before anyone applies an update —
+    otherwise the replicas' "replicated" params would silently diverge."""
 
     def local_step(state: TrainState, batch):
+        from ..utils import pytree as pt
+
         params = state.params
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         flat_g = jnp.pad(pt.flatten(grads)[0].astype(jnp.float32), (0, pad))
         # Averaged 1/n-th of the gradient lands on its owner shard.
         g_mine = comm.psum_scatter(flat_g, "data", scatter_dimension=0,
-                                   tiled=True,
-                                   label="zero1_grad_scatter") / n
+                                   tiled=True, label="zero1_grad_scatter",
+                                   scale=comm_scale) / n
         raw_flat, unravel = pt.flatten(params)
         flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
         shard = lax.axis_index("data")
         p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
-        updates, opt_state = optimizer.update(g_mine, state.opt_state, p_mine)
-        p_new = optax.apply_updates(p_mine, updates)
-        flat_new = comm.all_gather(p_new, "data", tiled=True,
-                                   label="zero1_param_gather")[:total]
+        new_p_mine, opt_state = apply_optimizer(optimizer, g_mine,
+                                                state.opt_state, p_mine)
+        loss = comm.pmean(loss, "data", label="loss_allreduce",
+                          scale=comm_scale)
+        if guard_nonfinite:
+            ok = jnp.all(jnp.isfinite(g_mine)) & jnp.isfinite(loss)
+            ok = comm.psum(ok.astype(jnp.int32), "data",
+                           label="zero1_guard_verdict",
+                           scale=comm_scale) == n
+            new_p_mine = jnp.where(ok, new_p_mine, p_mine)
+            opt_state = jax.tree.map(lambda nw, o: jnp.where(ok, nw, o),
+                                     opt_state, state.opt_state)
+            step = state.step + ok.astype(state.step.dtype)
+        else:
+            step = state.step + 1
+        flat_new = comm.all_gather(new_p_mine, "data", tiled=True,
+                                   label="zero1_param_gather",
+                                   scale=comm_scale)[:total]
         # Cast back before unravel: for single-dtype trees ravel_pytree's
         # unravel is dtype-polymorphic and would silently rebuild non-fp32
         # params (e.g. param_dtype="bfloat16") as fp32.
         new_params = unravel(flat_new.astype(raw_flat.dtype))
-        loss = comm.pmean(loss, "data", label="loss_allreduce")
-        return TrainState(new_params, opt_state, state.step + 1), loss
+        return TrainState(new_params, opt_state, step), loss
 
+    return local_step
+
+
+def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, params, *,
+                    guard_nonfinite: bool = False) -> Tuple[TrainState, Callable]:
+    """ZeRO-1 data parallelism: optimizer state sharded across the ``data``
+    axis (parity-plus — SURVEY.md §2.10 marks ZeRO/FSDP absent in the
+    reference; pattern reference: "Automatic Cross-Replica Sharding of
+    Weight Update in Data-Parallel Training", arxiv 2004.13336, PAPERS.md).
+
+    Per step, on each shard: local grads → ``lax.psum_scatter`` (averaged
+    1/n-th of the flattened gradient, half the allreduce's wire volume for
+    this leg) → optimizer update on the LOCAL moment slice only →
+    ``lax.all_gather`` of the updated parameter slice. Params stay
+    replicated; Adam's mu/nu shrink to 1/n per device — the memory that
+    caps model size under plain DP — and the update FLOPs drop n× with
+    them. Ring wire bytes stay at allreduce parity: scatter ``(n−1)/n`` +
+    gather ``(n−1)``·(1/n local shard) ≈ allreduce's ``2(n−1)/n`` —
+    verified against the telemetry comm profile in tests/test_dp.py.
+
+    Exact-equivalence caveat: valid for elementwise optimizers (sgd, adam,
+    adamw, ...) whose update at coordinate i depends only on history at i —
+    slicing commutes with the update rule (ops/adam.py), so the result is
+    bit-comparable to ``make_grad_aggregation_step`` (asserted in
+    tests/test_dp.py). The update goes through ``apply_optimizer``, so the
+    fused-apply fast path (ops/pallas_adam.py) works on the slice too.
+
+    ``guard_nonfinite`` fuses the in-jit skip guard, at the cost of one
+    4-byte psum per step (see ``_make_zero1_local_step``).
+
+    Returns ``(state, step_fn)`` — the initial TrainState with sharded
+    moments, and ``step_fn(state, batch) -> (state, loss)``.
+
+    Transient-memory note: each step ravels the replicated params/grads into
+    one padded fp32 vector before the scatter — a ~2·|params| fp32 transient
+    per device. The *persistent* saving (moments at 1/n, the 2/3 of Adam
+    state that caps model size) is what ZeRO-1 is for; a fully flat-resident
+    params layout would trade API simplicity for removing the transient.
+    """
+    state, opt_specs, n, pad, local, total = _zero1_setup(optimizer, mesh,
+                                                          params)
+    local_step = _make_zero1_local_step(loss_fn, optimizer, n, pad, local,
+                                        total, guard_nonfinite=guard_nonfinite)
     step = shard_map(
         local_step, mesh=mesh,
         in_specs=(TrainState(P(), opt_specs, P()), P("data")),
+        out_specs=(TrainState(P(), opt_specs, P()), P()),
+        check_vma=False)
+    return state, jax.jit(step, donate_argnums=(0,))
+
+
+def make_zero1_multi_step(loss_fn: Callable,
+                          optimizer: optax.GradientTransformation,
+                          mesh: Mesh, params, *,
+                          guard_nonfinite: bool = False
+                          ) -> Tuple[TrainState, Callable]:
+    """The two hot-path levers composed: the ZeRO-1 sharded weight update
+    *inside* the K-step scan driver. ``step(state, window) -> (state,
+    losses)`` with ``window`` a ``[K, n_shards·B, T]`` batch window
+    (``shard_batch_window``) — one donated dispatch runs K full
+    reduce-scatter → sliced-update → all-gather steps, moments staying
+    sharded in the scan carry throughout. Same equivalence contract as
+    ``make_zero1_step`` (fp32-tolerance vs the replicated update), same
+    per-step wire bytes (comm profile records ``scale=K``)."""
+    state, opt_specs, n, pad, local, total = _zero1_setup(optimizer, mesh,
+                                                          params)
+
+    def multi(state: TrainState, window):
+        local_step = _make_zero1_local_step(
+            loss_fn, optimizer, n, pad, local, total,
+            guard_nonfinite=guard_nonfinite, comm_scale=window.shape[0])
+        return lax.scan(local_step, state, window)
+
+    step = shard_map(
+        multi, mesh=mesh,
+        in_specs=(TrainState(P(), opt_specs, P()), P(None, "data")),
         out_specs=(TrainState(P(), opt_specs, P()), P()),
         check_vma=False)
     return state, jax.jit(step, donate_argnums=(0,))
@@ -286,6 +428,13 @@ def shard_batch(mesh: Mesh, batch) -> jax.Array:
     """Device-put a [n_shards·B, ...] host batch with leading axis sharded
     over ``data``."""
     return jax.device_put(batch, NamedSharding(mesh, P("data")))
+
+
+def shard_batch_window(mesh: Mesh, window) -> jax.Array:
+    """Device-put a [K, n_shards·B, T] host batch window for the multi-step
+    drivers: leading axis = K consecutive steps (replicated — every shard
+    scans the same step sequence), second axis sharded over ``data``."""
+    return jax.device_put(window, NamedSharding(mesh, P(None, "data")))
 
 
 def replicate(mesh: Mesh, tree):

@@ -33,7 +33,18 @@ it in the headline JSON.
 
 For a sync-free in-step alternative (skip only, no EMA/rollback), see
 ``parallel/dp.py``'s ``guard_nonfinite`` — the post-allreduce finiteness
-guard fused into the step itself.
+guard fused into the step itself (the zero1 variant adds a 4-byte psum so
+every replica agrees on the verdict before applying its slice update).
+
+Chunked stepping (train/llm.py ``steps_per_dispatch`` > 1): the guard
+wraps the fused K-step driver unchanged — ``loss`` is then the scan's [K]
+per-step vector and the verdict/skip/rollback granularity is one DISPATCH.
+A bad dispatch skips (consumes-not-learns) all K of its steps, which is
+why ``stats.skipped_steps`` counts ``loss.size`` train steps per skip
+while ``anomalies``/``rollbacks`` stay per-event; the EMA detector learns
+chunk-level update norms, consistent within a run because the chunk size
+is fixed. Stream-position step indexing is untouched, so resume stays
+deterministic.
 """
 
 from __future__ import annotations
@@ -119,10 +130,11 @@ class StepGuard:
             self._consecutive_bad = 0
             return new_state, loss
         # Bad step: count, skip (numerically a no-op), maybe roll back.
+        # A chunked dispatch (vector loss) skips loss.size train steps.
         if anomalous:
             self.stats.anomalies += 1
         else:
-            self.stats.skipped_steps += 1
+            self.stats.skipped_steps += int(getattr(loss, "size", 1) or 1)
         self._consecutive_bad += 1
         if (self._ckpt is not None
                 and self._consecutive_bad >= self.max_consecutive_bad):

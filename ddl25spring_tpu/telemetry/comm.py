@@ -125,14 +125,29 @@ class CommProfile:
             agg["wire_bytes_per_device"] += r.wire_bytes_per_device * r.scale
         return out
 
-    def as_dict(self) -> dict:
-        """JSON-able shape for the run manifest / bench telemetry block."""
-        return {
+    def as_dict(self, *, steps_per_dispatch: int = 1) -> dict:
+        """JSON-able shape for the run manifest / bench telemetry block.
+
+        The profile's aggregates cover one traced CALL. For a fused
+        multi-step driver (parallel/dp.py ``make_multi_step``) one call is
+        one dispatch of K steps — pass ``steps_per_dispatch=K`` and the
+        dict carries the per-TRAIN-STEP normalization alongside the
+        per-dispatch totals, so "wire bytes per step" stays comparable
+        across K (the no-regression check the zero1/scan work is held to).
+        """
+        d = {
             "payload_bytes_per_step": self.payload_bytes_per_step,
             "wire_bytes_per_device_per_step":
                 self.wire_bytes_per_device_per_step,
             "collectives": self.by_label(),
         }
+        if steps_per_dispatch > 1:
+            d["steps_per_dispatch"] = int(steps_per_dispatch)
+            d["payload_bytes_per_train_step"] = \
+                self.payload_bytes_per_step / steps_per_dispatch
+            d["wire_bytes_per_device_per_train_step"] = \
+                self.wire_bytes_per_device_per_step / steps_per_dispatch
+        return d
 
 
 def _tree_bytes(tree: Any) -> int:

@@ -143,13 +143,16 @@ def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
 
 
 def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
-                   mesh, start_step: int, step_fn, state, n_data: int
-                   ) -> None:
+                   mesh, start_step: int, step_fn, state, n_data: int,
+                   steps_per_dispatch: int = 1) -> None:
     """Open a telemetry run: one manifest event carrying the configuration
     and the step's static communication profile (telemetry/comm.py —
     measured by abstract tracing BEFORE the first real call, so the trace
     lands in the jit cache and costs nothing extra). Must run on the
-    UNGUARDED step: StepGuard's host-side logic cannot be eval_shape'd."""
+    UNGUARDED step: StepGuard's host-side logic cannot be eval_shape'd.
+    ``steps_per_dispatch > 1`` traces the fused K-step driver over its
+    [K, B, T] window — the profile then covers one DISPATCH (K steps), with
+    per-step normalization carried alongside (CommProfile.as_dict)."""
     if telemetry is None:
         return
     import dataclasses
@@ -157,10 +160,13 @@ def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
     from ..telemetry import measure_comm
     comm_profile = None
     try:
-        batch_sds = jax.ShapeDtypeStruct(
-            (n_data * train_cfg.batch_size, train_cfg.seq_len), jnp.int32)
+        batch_shape = (n_data * train_cfg.batch_size, train_cfg.seq_len)
+        if steps_per_dispatch > 1:
+            batch_shape = (steps_per_dispatch,) + batch_shape
+        batch_sds = jax.ShapeDtypeStruct(batch_shape, jnp.int32)
         profile = measure_comm(step_fn, state, batch_sds)
-        comm_profile = profile.as_dict() if profile is not None else None
+        comm_profile = (profile.as_dict(steps_per_dispatch=steps_per_dispatch)
+                        if profile is not None else None)
     except Exception:
         pass                       # telemetry must never sink a trainer
     telemetry.events.manifest(
@@ -177,7 +183,8 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               loss_sink, sink_every: int, log_every: int, log_fn,
               warmup_steps_excluded: int,
               stats: Optional[ResilienceStats] = None,
-              telemetry=None) -> LLMTrainReport:
+              telemetry=None, steps_per_dispatch: int = 1,
+              window_shard_fn=None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
     and async-honest throughput accounting (the timer starts after
@@ -197,7 +204,40 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     deliberately not replayed, mirroring skip-and-count). That is what keeps
     resume deterministic: a checkpoint at step k always means "the stream
     has advanced k batches", so replay-to-k reproduces the data order no
-    matter how many steps were skipped or rolled back."""
+    matter how many steps were skipped or rolled back.
+
+    Loss buffering: device losses are held unsynced in a bounded pending
+    buffer and flushed to host floats at sink boundaries (every
+    ``sink_every`` steps and at the end) — the flush is where ``loss_sink``
+    already forced a sync, so bounding the buffer costs no extra host round
+    trips, and the old grow-O(iters) device-scalar list is gone.
+
+    Chunked mode (``steps_per_dispatch`` = K > 1; DP trainer only): the
+    step is a fused K-step driver (dp.make_multi_step /
+    make_zero1_multi_step) taking a ``[K, B, T]`` window via
+    ``window_shard_fn``, and every host-side decision quantizes to chunk
+    edges, whose positions are absolute multiples of K so they are stable
+    across resumes:
+
+    - the per-step loss sequence comes back as the scan's stacked [K]
+      output (bit-identical to per-step mode) and flushes through the same
+      pending buffer, so ``loss_sink``/CSV rows land on the same step
+      indices as per-step mode (delayed by at most a chunk);
+    - periodic checkpoints save at the first chunk edge at/after each
+      ``checkpoint_every`` boundary (exactly on it when K divides
+      ``checkpoint_every``); SIGTERM force-saves at the next chunk edge;
+      checkpoint step indices stay stream positions, so resume/replay is
+      unchanged (a resume from a non-chunk-aligned step — e.g. a checkpoint
+      written by a per-step run — realigns with one smaller first chunk);
+    - StepGuard verdicts/skips and FaultPlan injection points are per
+      DISPATCH: a skipped dispatch skips (consumes-not-learns) all K of its
+      steps, and fault step indices count dispatches, not steps;
+    - the throughput warmup exclusion quantizes up to the first chunk
+      (``warmup_steps_excluded`` is treated as "at least", so compile time
+      stays out of the timer either way);
+    - the next chunk's host window is staged while the device runs the
+      current one, so tokenization overlaps compute under async dispatch.
+    """
     report = LLMTrainReport()
     report.start_step = start_step
     report.resilience = stats if stats is not None else ResilienceStats()
@@ -209,115 +249,226 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     last_saved = -1
     tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
     t_start = None
-    device_losses = []  # keep losses on device; a float() per step would
-    #                     serialize dispatch and deflate throughput
+    excluded_steps = warmup_steps_excluded
+    pending = []  # (first step index, device loss scalar or [k] vector):
+    #               bounded — flushed to host floats at sink boundaries; a
+    #               float() per step would serialize dispatch and deflate
+    #               throughput, an unbounded device list would leak buffers.
+
+    def _flush_losses():
+        for it0, ls in pending:
+            for j, v in enumerate(np.atleast_1d(np.asarray(ls))):
+                i, v = it0 + j, float(v)
+                report.losses.append(v)
+                if loss_sink is not None and (i % sink_every == 0
+                                              or i == train_cfg.iters - 1):
+                    loss_sink(i, v)
+        pending.clear()
+
     # Installed with or without a checkpointer: an uncheckpointed run can't
     # force-save, but it still exits the loop cleanly on SIGTERM (counters
     # and report intact) instead of dying mid-step — a chaos run without
     # --checkpoint-dir must demo graceful preemption, not a hard kill.
     preempt = PreemptionHandler()
     last_it = start_step - 1
-    with preempt:
-        for it in range(train_cfg.iters):
+
+    def _force_save(at: int) -> None:
+        # Force-save a resumable checkpoint BEFORE dying: the next
+        # invocation restores step ``at`` and replays the stream.
+        # A checkpoint of THIS run's lineage at ``at`` exists only
+        # if this loop saved it (last_saved) or resumed from it
+        # (start_step); any other on-disk step ``at`` is a stale —
+        # possibly the corrupt — remnant of a pre-fallback lineage
+        # that the save must replace, not trust (latest_step() alone
+        # can't tell these apart after a corrupt-latest fallback).
+        if ckpt is not None:
+            if at not in (last_saved, start_step):
+                ckpt.save(at, state, force=True, overwrite=True)
+            ckpt.wait()
+        report.preempted = True
+        report.resilience.preemptions += 1
+        log_fn(f"preempted at iter {at}: checkpoint "
+               f"{'force-saved' if ckpt is not None else 'not saved'}"
+               f"{'' if ckpt is not None else ' (no checkpoint dir)'}")
+
+    if steps_per_dispatch <= 1:
+        with preempt:
+            for it in range(train_cfg.iters):
+                with spans("data"):
+                    host_batch = next(batches).reshape(
+                        n_data * train_cfg.batch_size, train_cfg.seq_len)
+                if it < start_step:
+                    # Replaying IS progress, but a beat per replayed batch
+                    # would add thousands of temp-file renames to an
+                    # otherwise host-only fast-forward; throttle to well
+                    # under the watchdog's polling granularity.
+                    if telemetry is not None:
+                        now = time.perf_counter()
+                        if now - last_replay_beat >= 0.5:
+                            telemetry.heartbeat.beat(step=it, phase="replay")
+                            last_replay_beat = now
+                    continue  # resume: replay stream, preserving data order
+                if preempt.requested:
+                    _force_save(it)
+                    break
+                last_it = it
+                t_iter = time.perf_counter()
+                with spans("dispatch"):
+                    state, loss = step_fn(state, shard_fn(host_batch))
+                if it + 1 == start_step + warmup_steps_excluded:
+                    float(loss)  # hard sync before starting the timer
+                    t_start = time.perf_counter()
+                    # Re-baseline the step-event window too: the time before
+                    # this sync is compile + (on resume) stream replay, which
+                    # would otherwise land in the first window's dt_s and
+                    # dominate obs_report's step-time percentiles.
+                    last_event_t, last_event_it = t_start, it
+                pending.append((it, loss))
+                if it % sink_every == 0 or it == train_cfg.iters - 1:
+                    _flush_losses()  # the sink boundary: host ring update
+                if log_every and it % log_every == 0:
+                    log_fn(f"iter {it}: loss {float(loss):.4f}")
+                if telemetry is not None:
+                    # Host-side iteration wall time: dispatch + host work,
+                    # NOT device completion (no sync; under async dispatch
+                    # read the honest throughput from tokens_per_sec / the
+                    # step events).
+                    telemetry.registry.observe("host_iter_s",
+                                               time.perf_counter() - t_iter)
+                    telemetry.heartbeat.beat(step=it)
+                    if (it % telemetry.step_every == 0
+                            or it == train_cfg.iters - 1):
+                        now = time.perf_counter()
+                        extra = {}
+                        if t_start is None:
+                            # Pre-baseline window: dt_s still contains
+                            # one-time compile/replay. Keep the event (its
+                            # loss matters) but flag it so readers exclude
+                            # it from step-time distributions (obs_report
+                            # does).
+                            extra["warmup"] = True
+                        telemetry.events.step(
+                            it=it, loss=float(loss),  # the documented sync
+                            dt_s=now - last_event_t,
+                            steps=it - last_event_it, **extra)
+                        last_event_t, last_event_it = now, it
+                    delta = report.resilience.delta(prev_counters)
+                    if delta:
+                        telemetry.events.fault(counters=delta, it=it)
+                        prev_counters = report.resilience.as_dict()
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    try:
+                        # overwrite: after a corrupt-latest fallback resume
+                        # the loop re-treads step indices the dead lineage
+                        # already wrote (start_step < it+1 <= old latest),
+                        # and those stale entries must not survive as
+                        # restore candidates.
+                        with spans("checkpoint"):
+                            ckpt.save(it + 1, state, overwrite=True)
+                        last_saved = it + 1
+                    except Exception as e:
+                        log_fn(f"periodic checkpoint at {it + 1} failed "
+                               f"after retries ({type(e).__name__}: {e}); "
+                               "continuing")
+    else:
+        # ------------------------------------------------- chunked mode
+        K = steps_per_dispatch
+        chunks = []
+        edge = start_step
+        while edge < train_cfg.iters:
+            nxt = min(train_cfg.iters, (edge // K + 1) * K)
+            chunks.append((edge, nxt))
+            edge = nxt
+
+        def _window(it0, it1):
             with spans("data"):
-                host_batch = next(batches).reshape(
-                    n_data * train_cfg.batch_size, train_cfg.seq_len)
-            if it < start_step:
-                # Replaying IS progress, but a beat per replayed batch
-                # would add thousands of temp-file renames to an otherwise
-                # host-only fast-forward; throttle to well under the
-                # watchdog's polling granularity.
+                return np.stack([
+                    next(batches).reshape(n_data * train_cfg.batch_size,
+                                          train_cfg.seq_len)
+                    for _ in range(it1 - it0)])
+
+        staged = None
+        last_flush_edge = start_step
+        with preempt:
+            for rep in range(start_step):   # resume: replay the stream
+                next(batches)
                 if telemetry is not None:
                     now = time.perf_counter()
                     if now - last_replay_beat >= 0.5:
-                        telemetry.heartbeat.beat(step=it, phase="replay")
+                        telemetry.heartbeat.beat(step=rep, phase="replay")
                         last_replay_beat = now
-                continue  # resume: replay the stream, preserving data order
-            if preempt.requested:
-                # Force-save a resumable checkpoint BEFORE dying: the next
-                # invocation restores step ``it`` and replays the stream.
-                # A checkpoint of THIS run's lineage at ``it`` exists only
-                # if this loop saved it (last_saved) or resumed from it
-                # (start_step); any other on-disk step ``it`` is a stale —
-                # possibly the corrupt — remnant of a pre-fallback lineage
-                # that the save must replace, not trust (latest_step() alone
-                # can't tell these apart after a corrupt-latest fallback).
-                if ckpt is not None:
-                    if it not in (last_saved, start_step):
-                        ckpt.save(it, state, force=True, overwrite=True)
-                    ckpt.wait()
-                report.preempted = True
-                report.resilience.preemptions += 1
-                log_fn(f"preempted at iter {it}: checkpoint "
-                       f"{'force-saved' if ckpt is not None else 'not saved'}"
-                       f"{'' if ckpt is not None else ' (no checkpoint dir)'}")
-                break
-            last_it = it
-            t_iter = time.perf_counter()
-            with spans("dispatch"):
-                state, loss = step_fn(state, shard_fn(host_batch))
-            if it + 1 == start_step + warmup_steps_excluded:
-                float(loss)  # hard sync before starting the timer
-                t_start = time.perf_counter()
-                # Re-baseline the step-event window too: the time before
-                # this sync is compile + (on resume) stream replay, which
-                # would otherwise land in the first window's dt_s and
-                # dominate obs_report's step-time percentiles.
-                last_event_t, last_event_it = t_start, it
-            device_losses.append(loss)
-            if loss_sink is not None and (it % sink_every == 0
-                                          or it == train_cfg.iters - 1):
-                loss_sink(it, float(loss))
-            if log_every and it % log_every == 0:
-                log_fn(f"iter {it}: loss {float(loss):.4f}")
-            if telemetry is not None:
-                # Host-side iteration wall time: dispatch + host work, NOT
-                # device completion (no sync; under async dispatch read the
-                # honest throughput from tokens_per_sec / the step events).
-                telemetry.registry.observe("host_iter_s",
-                                           time.perf_counter() - t_iter)
-                telemetry.heartbeat.beat(step=it)
-                if (it % telemetry.step_every == 0
-                        or it == train_cfg.iters - 1):
-                    now = time.perf_counter()
-                    extra = {}
-                    if t_start is None:
-                        # Pre-baseline window: dt_s still contains one-time
-                        # compile/replay. Keep the event (its loss matters)
-                        # but flag it so readers exclude it from step-time
-                        # distributions (obs_report does).
-                        extra["warmup"] = True
-                    telemetry.events.step(
-                        it=it, loss=float(loss),  # the documented sync
-                        dt_s=now - last_event_t,
-                        steps=it - last_event_it, **extra)
-                    last_event_t, last_event_it = now, it
-                delta = report.resilience.delta(prev_counters)
-                if delta:
-                    telemetry.events.fault(counters=delta, it=it)
-                    prev_counters = report.resilience.as_dict()
-            if ckpt is not None and (it + 1) % checkpoint_every == 0:
-                try:
-                    # overwrite: after a corrupt-latest fallback resume the
-                    # loop re-treads step indices the dead lineage already
-                    # wrote (start_step < it+1 <= old latest), and those
-                    # stale entries must not survive as restore candidates.
-                    with spans("checkpoint"):
-                        ckpt.save(it + 1, state, overwrite=True)
-                    last_saved = it + 1
-                except Exception as e:
-                    log_fn(f"periodic checkpoint at {it + 1} failed after "
-                           f"retries ({type(e).__name__}: {e}); continuing")
+            for ci, (it0, it1) in enumerate(chunks):
+                if preempt.requested:
+                    _force_save(it0)
+                    break
+                window = staged if staged is not None else _window(it0, it1)
+                staged = None
+                t_iter = time.perf_counter()
+                with spans("dispatch"):
+                    state, losses = step_fn(state, window_shard_fn(window))
+                # Stage the NEXT chunk's host window while the device runs
+                # this one: under async dispatch the tokenize/stack work
+                # overlaps compute instead of serializing after it.
+                if ci + 1 < len(chunks):
+                    staged = _window(*chunks[ci + 1])
+                last_it = it1 - 1
+                first_chunk = t_start is None
+                pending.append((it0, losses))
+                if log_every:
+                    for i in range(it0, it1):
+                        if i % log_every == 0:
+                            log_fn(f"iter {i}: "
+                                   f"loss {float(losses[i - it0]):.4f}")
+                if telemetry is not None:
+                    telemetry.registry.observe(  # per DISPATCH (K steps)
+                        "host_iter_s", time.perf_counter() - t_iter)
+                    telemetry.heartbeat.beat(step=last_it)
+                    if (last_it - last_event_it >= telemetry.step_every
+                            or it1 == train_cfg.iters):
+                        now = time.perf_counter()
+                        extra = {"steps_per_dispatch": it1 - it0}
+                        if first_chunk:
+                            extra["warmup"] = True  # dt contains compile
+                        telemetry.events.step(
+                            it=last_it, loss=float(losses[-1]),
+                            dt_s=now - last_event_t,
+                            steps=last_it - last_event_it, **extra)
+                        last_event_t, last_event_it = now, last_it
+                    delta = report.resilience.delta(prev_counters)
+                    if delta:
+                        telemetry.events.fault(counters=delta, it=last_it)
+                        prev_counters = report.resilience.as_dict()
+                if first_chunk:
+                    # Warmup exclusion quantized to the first chunk edge:
+                    # compile + (on resume) replay land before this sync.
+                    float(losses[-1])
+                    t_start = time.perf_counter()
+                    excluded_steps = it1 - it0
+                    last_event_t, last_event_it = t_start, last_it
+                if (it1 - last_flush_edge >= sink_every
+                        or it1 == train_cfg.iters):
+                    _flush_losses()  # sink boundary (chunk-edge quantized)
+                    last_flush_edge = it1
+                if ckpt is not None and (it1 // checkpoint_every
+                                         ) > (it0 // checkpoint_every):
+                    try:
+                        with spans("checkpoint"):
+                            ckpt.save(it1, state, overwrite=True)
+                        last_saved = it1
+                    except Exception as e:
+                        log_fn(f"periodic checkpoint at {it1} failed after "
+                               f"retries ({type(e).__name__}: {e}); "
+                               "continuing")
     if ckpt is not None:
         if not report.preempted and train_cfg.iters != last_saved:
             ckpt.save(train_cfg.iters, state, force=True, overwrite=True)
         ckpt.close()
-    report.losses = [float(l) for l in device_losses]  # syncs the chain
+    _flush_losses()  # preempted/odd-tail runs: drain whatever is buffered
     report.steps = (last_it + 1 if report.preempted else train_cfg.iters) \
         - start_step
-    if t_start is not None and report.steps > warmup_steps_excluded:
+    if t_start is not None and report.steps > excluded_steps:
         report.wall_time = time.perf_counter() - t_start
-        timed = report.steps - warmup_steps_excluded
+        timed = report.steps - excluded_steps
         report.tokens_per_sec = tokens_per_step * timed / report.wall_time
     if telemetry is not None:
         telemetry.registry.absorb_spans(spans)
@@ -370,8 +521,17 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  telemetry=None) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
-    ``aggregation``: "gradient" (allreduce grads — intro_DP_GA) or "weight"
-    (allreduce weights post-step — intro_DP_WA's intended semantics).
+    ``aggregation``: "gradient" (allreduce grads — intro_DP_GA), "weight"
+    (allreduce weights post-step — intro_DP_WA's intended semantics), or
+    "zero1" (ZeRO-1 sharded weight update, dp.make_zero1_step: gradients
+    reduce-scattered, Adam applied to each replica's 1/N slice with
+    optimizer state sharded from init, fresh params all-gathered — N× less
+    optimizer memory and update FLOPs at allreduce-parity wire bytes).
+
+    ``train_cfg.steps_per_dispatch`` = K > 1 turns on the fused multi-step
+    driver (gradient/zero1 aggregation, fp32 wire only): K steps scanned in
+    one compiled, donated dispatch over a [K, B, T] batch window, host work
+    quantized to chunk edges — semantics spelled out in ``_run_loop``.
 
     ``loss_sink(it, loss)`` fires every ``sink_every`` iterations with the
     host-synced loss — for incremental result recording that survives a
@@ -413,18 +573,24 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         # causal_lm_loss(llama.forward(...)) — asserted in tests/test_core.py.
         return llama.forward_loss(p, batch, model_cfg)
 
-    state = dp.replicate(mesh, dp.init_state(params, optimizer))
+    spd = train_cfg.steps_per_dispatch
+    if spd < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
+    state = None
     if train_cfg.wire != "fp32":
         # Compressed gradient allreduce (parallel/compress.py) — gradient
         # aggregation only, and accumulation stays at 1 (the compressed
         # steps own their collective schedule). Hard errors, not asserts:
         # a stripped assert (python -O) would silently run the wrong
         # aggregation algorithm.
-        if aggregation != "gradient" or train_cfg.accum_steps != 1:
+        if aggregation != "gradient" or train_cfg.accum_steps != 1 \
+                or spd != 1:
             raise ValueError(
                 "wire compression requires gradient aggregation without "
-                f"accumulation (got aggregation={aggregation!r}, "
-                f"accum_steps={train_cfg.accum_steps})")
+                "accumulation or multi-step dispatch (got "
+                f"aggregation={aggregation!r}, "
+                f"accum_steps={train_cfg.accum_steps}, "
+                f"steps_per_dispatch={spd})")
         from ..parallel import compress
         if train_cfg.wire == "bf16":
             step_fn = compress.make_bf16_grad_step(loss_fn, optimizer, mesh)
@@ -434,13 +600,36 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                                                       mesh)
         else:
             raise ValueError(f"unknown wire format {train_cfg.wire!r}")
+    elif aggregation == "zero1":
+        if train_cfg.accum_steps != 1:
+            raise ValueError("accum_steps composes with gradient "
+                             "aggregation only (zero1 scatters the raw "
+                             "local gradient)")
+        if spd > 1:
+            state, step_fn = dp.make_zero1_multi_step(loss_fn, optimizer,
+                                                      mesh, params)
+        else:
+            state, step_fn = dp.make_zero1_step(loss_fn, optimizer, mesh,
+                                                params)
     elif aggregation == "gradient":
-        step_fn = dp.make_grad_aggregation_step(
-            loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
-    else:
+        if spd > 1:
+            step_fn = dp.make_multi_step(
+                loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
+        else:
+            step_fn = dp.make_grad_aggregation_step(
+                loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
+    elif aggregation == "weight":
         if train_cfg.accum_steps != 1:
             raise ValueError("accum_steps needs gradient aggregation")
+        if spd != 1:
+            raise ValueError("steps_per_dispatch > 1 supports gradient and "
+                             "zero1 aggregation only")
         step_fn = dp.make_weight_aggregation_step(loss_fn, optimizer, mesh)
+    else:
+        raise ValueError(f"unknown aggregation {aggregation!r}: expected "
+                         "'gradient', 'weight' or 'zero1'")
+    if state is None:
+        state = dp.replicate(mesh, dp.init_state(params, optimizer))
 
     stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
@@ -450,7 +639,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         return LLMTrainReport(resilience=stats)
     _emit_manifest(telemetry, trainer="dp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
-                   step_fn=step_fn, state=state, n_data=n_data)
+                   step_fn=step_fn, state=state, n_data=n_data,
+                   steps_per_dispatch=spd)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     # Disjoint stream windows per data shard — the reference's skip=rank*5000.
@@ -463,7 +653,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      sink_every=sink_every, log_every=log_every,
                      log_fn=log_fn,
                      warmup_steps_excluded=warmup_steps_excluded,
-                     stats=stats, telemetry=telemetry)
+                     stats=stats, telemetry=telemetry,
+                     steps_per_dispatch=spd,
+                     window_shard_fn=lambda w: dp.shard_batch_window(mesh, w))
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
@@ -506,6 +698,10 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     if train_cfg.wire != "fp32":
         raise ValueError("wire compression (TrainConfig.wire) is DP-trainer-"
                          "only; the pipeline step owns its own collectives")
+    if train_cfg.steps_per_dispatch != 1:
+        raise ValueError("steps_per_dispatch (fused multi-step dispatch) is "
+                         "DP-trainer-only; the pipeline step owns its own "
+                         "schedule")
     mesh = mesh or make_mesh({"data": train_cfg.data,
                               "stage": train_cfg.stage})
     n_data = mesh.shape.get("data", 1)

@@ -36,13 +36,25 @@ CONFIGS = {
 def _run_config(name: str, iters: int, sink, provenance: str,
                 checkpoint_dir: str = None, faults: str = "",
                 fault_seed: int = 0, guard: bool = False,
-                telemetry_dir: str = None) -> Dict[str, float]:
+                telemetry_dir: str = None, steps_per_dispatch: int = 1,
+                zero1: bool = False) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
-    train_cfg = TrainConfig(iters=iters, **topo)  # batch 3/shard, Adam 8e-4
+    if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1):
+        # Both hot-path levers are DP-trainer-only (the PP step owns its
+        # own schedule/collectives); failing loudly beats silently timing
+        # the wrong program.
+        raise ValueError(f"--steps-per-dispatch/--zero1 need a DP config "
+                         f"(got {name})")
+    train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
+                            **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
     label = f"{name}_b{train_cfg.data * train_cfg.batch_size}_seq256_adam8e-4"
+    if steps_per_dispatch != 1:
+        label += f"_k{steps_per_dispatch}"
+    if zero1:
+        label += "_zero1"
     log_every = max(1, min(iters // 10, 25))
     kw = {}
     if checkpoint_dir is not None:
@@ -82,6 +94,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
             report = train_llm_pp(model_cfg, train_cfg, log_every=log_every,
                                   **kw)
         else:
+            if zero1:
+                kw["aggregation"] = "zero1"
             report = train_llm_dp(model_cfg, train_cfg, log_every=log_every,
                                   **kw)
     finally:
@@ -116,7 +130,8 @@ def main(quick: bool = False, iters: int = 5000,
          configs=("dp1",), append: bool = False,
          checkpoint_dir: str = None, faults: str = "",
          fault_seed: int = 0, guard: bool = False,
-         telemetry_dir: str = None) -> Dict[str, float]:
+         telemetry_dir: str = None, steps_per_dispatch: int = 1,
+         zero1: bool = False) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -143,7 +158,9 @@ def main(quick: bool = False, iters: int = 5000,
         out.update(_run_config(name, iters, sink, provenance,
                                checkpoint_dir=checkpoint_dir, faults=faults,
                                fault_seed=fault_seed, guard=guard,
-                               telemetry_dir=telemetry_dir))
+                               telemetry_dir=telemetry_dir,
+                               steps_per_dispatch=steps_per_dispatch,
+                               zero1=zero1))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -181,6 +198,17 @@ if __name__ == "__main__":
                          "under this dir (telemetry/); point the watchdog's "
                          "--heartbeat at <dir>/<config>/heartbeat.json and "
                          "render with python -m experiments.obs_report")
+    ap.add_argument("--steps-per-dispatch", type=int, default=1,
+                    help="fuse K training steps into one compiled dispatch "
+                         "(lax.scan over a [K, B, T] window — dp.make_multi_"
+                         "step; DP configs only; loss trajectory bit-"
+                         "identical to K=1, host work quantized to chunk "
+                         "edges)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1 sharded weight update (dp.make_zero1_step: "
+                         "reduce-scatter grads, Adam on each replica's 1/N "
+                         "slice, all-gather params; DP configs only — "
+                         "composes with --steps-per-dispatch)")
     a = ap.parse_args()
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual
@@ -193,4 +221,5 @@ if __name__ == "__main__":
     main(quick=a.quick, iters=a.iters, configs=a.configs, append=a.append,
          checkpoint_dir=a.checkpoint_dir, faults=a.faults,
          fault_seed=a.fault_seed, guard=a.guard,
-         telemetry_dir=a.telemetry_dir)
+         telemetry_dir=a.telemetry_dir,
+         steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1)

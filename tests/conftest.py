@@ -31,11 +31,17 @@ jax.config.update("jax_platforms", "cpu")
 # Serialize dispatch: overlapped steps' collectives can deadlock the virtual
 # CPU mesh (failure mode 2 in experiments/_cpu_pin.py).
 jax.config.update("jax_cpu_enable_async_dispatch", False)
-# NOTE: do NOT enable the persistent XLA compilation cache
-# (jax_compilation_cache_dir) here: on this jaxlib (0.4.36) a cached
-# executable with donated input buffers segfaults the whole test process
-# when reloaded on the CPU backend (reproduced in the trainer-resume tests).
-# The ~28% warm-cache wall-time win is not worth a crashing suite.
+# Persistent XLA compilation cache — version-gated, NOT unconditional: on
+# jaxlib 0.4.36 (this container) a cached executable with donated input
+# buffers segfaults the whole test process when reloaded on the CPU backend
+# (reproduced in the trainer-resume tests), so the helper declines there
+# and the suite runs exactly as before. On newer jaxlibs (CI installs
+# current jax) the ~28% warm-cache wall-time win relieves the 870 s tier-1
+# budget. CI scopes the dir to the runner tempdir via
+# $DDL25_COMPILATION_CACHE_DIR (tier1.yml).
+from ddl25spring_tpu.utils.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
 
 
 @pytest.fixture(scope="session")

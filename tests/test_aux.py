@@ -314,3 +314,38 @@ def test_eval_llm_heldout():
     assert np.isfinite(m["loss"]) and m["perplexity"] > 1
     assert abs(m["loss"] - math.log(tok.vocab_size)) < 1.0
     assert m["n_tokens"] == 2 * 2 * (16 - 1)  # T-1 scored positions/sequence
+
+
+def test_train_llm_dp_chunked_checkpoint_resume_realigns(tmp_path):
+    """Chunked-dispatch resume: a checkpoint at a NON-chunk-aligned step
+    (iters=3 with K=2 final-saves at 3) must realign with one smaller first
+    chunk and stitch bitwise-deterministically onto the per-step
+    trajectory — checkpoint indices stay stream positions, sink rows keep
+    absolute indices (train/llm.py _run_loop chunked mode)."""
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.train.llm import train_llm_dp
+
+    model_cfg = LlamaConfig(vocab_size=128, dmodel=16, num_heads=2,
+                            n_layers=2, ctx_size=16)
+    kw = dict(log_every=0, warmup_steps_excluded=1)
+    base = dict(batch_size=2, seq_len=16, seed=3)
+
+    full = train_llm_dp(model_cfg, TrainConfig(iters=6, **base), **kw)
+
+    ck = str(tmp_path / "ck")
+    first = train_llm_dp(model_cfg,
+                         TrainConfig(iters=3, steps_per_dispatch=2, **base),
+                         **kw, checkpoint_dir=ck, checkpoint_every=100)
+    sunk = []
+    resumed = train_llm_dp(model_cfg,
+                           TrainConfig(iters=6, steps_per_dispatch=2, **base),
+                           **kw, checkpoint_dir=ck, checkpoint_every=100,
+                           loss_sink=lambda it, l: sunk.append((it, l)),
+                           sink_every=1)
+    assert len(first.losses) == 3 and len(resumed.losses) == 3
+    assert resumed.start_step == 3
+    np.testing.assert_allclose(first.losses + resumed.losses, full.losses,
+                               rtol=2e-5)
+    assert [it for it, _ in sunk] == [3, 4, 5]  # absolute stream positions
+    np.testing.assert_allclose([l for _, l in sunk], resumed.losses,
+                               rtol=1e-6)

@@ -126,3 +126,36 @@ def test_train_test_split():
     xtr, ytr, xte, yte = tabular.train_test_split(X, y, test_fraction=0.2, seed=0)
     assert len(xte) == int(len(y) * 0.2)
     assert len(xtr) + len(xte) == len(y)
+
+
+# ------------------------------------------------------------ pad_batches
+
+def test_pad_batches_exact_multiple_no_padding():
+    """n % batch_size == 0 (pad == 0): no rows added, the mask is all-ones,
+    and the reshape is a pure view of the input order — the path every
+    full-batch workload takes, previously only exercised indirectly."""
+    from ddl25spring_tpu.train.batching import pad_batches
+
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.int32)
+    (xb,), yb, mask = pad_batches([x], y, batch_size=3)
+    assert xb.shape == (2, 3, 2) and yb.shape == (2, 3)
+    assert mask.shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(mask), np.ones((2, 3), np.float32))
+    np.testing.assert_array_equal(np.asarray(xb).reshape(6, 2), x)
+    np.testing.assert_array_equal(np.asarray(yb).reshape(6), y)
+
+
+def test_pad_batches_remainder_masks_padding():
+    """n % batch_size != 0: the tail is zero-padded and mask-flagged so
+    mask-weighted losses match the unpadded data exactly."""
+    from ddl25spring_tpu.train.batching import pad_batches
+
+    x = np.ones((5, 2), np.float32)
+    y = np.arange(5, dtype=np.int32)
+    (xb,), yb, mask = pad_batches([x], y, batch_size=3)
+    assert xb.shape == (2, 3, 2)
+    m = np.asarray(mask)
+    assert m.sum() == 5 and m[1, 2] == 0.0
+    np.testing.assert_array_equal(np.asarray(xb)[1, 2], np.zeros(2))
+    assert int(np.asarray(yb)[1, 2]) == 0

@@ -99,6 +99,25 @@ def test_prefetch_runs_ahead(pair):
     nat.close()
 
 
+def test_prefetch_counter_and_double_close(pair):
+    """batches_produced() keeps advancing ahead of consumption, and close()
+    is idempotent: the second close (and the __del__ after an explicit
+    close) must not double-free the native handle."""
+    import time
+    py, _ = pair
+    nat = NativeTokenStream(py, batch_size=2, seq_len=16, prefetch=2)
+    consumed = nat.next_batch()
+    assert consumed.shape == (2, 16)
+    deadline = time.time() + 5.0
+    while nat.batches_produced() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert nat.batches_produced() >= 2  # producer ran ahead of 1 consume
+    nat.close()
+    assert nat._handle is None          # close() cleared the handle...
+    nat.close()                         # ...so a second close is a no-op
+    nat.__del__()                       # and so is finalization after close
+
+
 def test_synthetic_batches_shape_and_determinism(pair):
     py, _ = pair
     a = NativeTokenStream(py, batch_size=3, seq_len=24, seed=7)

@@ -538,6 +538,42 @@ def test_trainer_telemetry_end_to_end(tmp_path, devices):
     assert report_main([str(tmp_path / "run")]) == 0
 
 
+def test_trainer_telemetry_chunked_dispatch(tmp_path, devices):
+    """Chunked mode (steps_per_dispatch=2): the manifest's comm profile
+    covers one DISPATCH with the per-train-step normalization alongside
+    (CommProfile.as_dict), step events land on chunk edges carrying the
+    window size, and obs_report still renders the run."""
+    n = 2
+    with Telemetry(str(tmp_path / "run"), step_every=2) as tel:
+        from ddl25spring_tpu.train.llm import train_llm_dp
+        report = train_llm_dp(
+            model_cfg=TINY,
+            train_cfg=TrainConfig(batch_size=2, seq_len=16, iters=6,
+                                  lr=3e-3, data=n, steps_per_dispatch=2),
+            mesh=make_mesh({"data": n}, devices=devices[:n]),
+            tokenizer=ByteTokenizer(), log_every=0, telemetry=tel)
+        events = read_events(tel.events_path, strict=True)
+    by_type = {}
+    for e in events:
+        by_type.setdefault(e["type"], []).append(e)
+    comm = by_type["manifest"][0]["comm"]
+    assert comm["steps_per_dispatch"] == 2
+    # One dispatch = 2 recorded steps of traffic; the normalization halves.
+    assert comm["payload_bytes_per_train_step"] == pytest.approx(
+        comm["payload_bytes_per_step"] / 2)
+    params = llama.init_llama(jax.random.key(0), TINY)
+    assert comm["collectives"]["grad_allreduce"]["payload_bytes"] == \
+        2 * _param_bytes(params, 4)
+    steps = by_type["step"]
+    assert [e["it"] for e in steps] == [1, 3, 5]   # chunk edges
+    assert all(e["steps_per_dispatch"] == 2 for e in steps)
+    assert steps[0].get("warmup") is True          # compile chunk flagged
+    assert by_type["run_end"][0]["steps"] == report.steps == 6
+    assert len(report.losses) == 6
+    from experiments.obs_report import main as report_main
+    assert report_main([str(tmp_path / "run")]) == 0
+
+
 def test_fl_server_emits_round_events(tmp_path):
     """FL servers report through the same stream: one fl_round per round
     with accuracy/wall/messages, plus manifest and run_end."""
