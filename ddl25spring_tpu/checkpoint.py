@@ -15,15 +15,29 @@ whose shapes/shardings define the layout to materialize into.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import tempfile
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .metrics import ResilienceStats
 from .resilience.retry import retry_call
+
+MANIFEST_VERSION = 1
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class Checkpointer:
@@ -42,6 +56,24 @@ class Checkpointer:
     counted in ``stats.ckpt_fallbacks`` — so a checkpoint truncated by a
     mid-write kill costs ``checkpoint_every`` steps of progress, never the
     run. ``max_to_keep >= 2`` is what makes the fallback non-vacuous.
+
+    Integrity manifests: each save records a per-step JSON manifest
+    (``<dir>/digests/<step>.json``) of shard-file SHA-256 digests — written
+    once the async save lands (``wait``/``restore``/``close`` flush it) —
+    plus the saved leaf shapes/dtypes. ``restore`` verifies digests BEFORE
+    handing the step to orbax, so a silent on-disk bit-flip (injectable via
+    ``resilience/faults.py``) is detected and skipped as a
+    ``ckpt_fallbacks`` fallback instead of restoring poisoned weights
+    bit-exactly. Steps saved without a manifest (pre-manifest checkpoints)
+    restore unverified, as before.
+
+    Cross-topology restore (elastic re-mesh, resilience/elastic.py): when
+    the manifest's saved leaf shapes differ from ``template``'s — a ZeRO-1
+    state saved at world size N restored onto M survivors — the step is
+    restored at its SAVED shapes (replicated) and resharded into the
+    template via ``parallel.dp.reshard_state`` (pad-swap with a hard error
+    on non-zero truncated tails, never orbax's silent shape adaptation).
+    Counted in ``stats.ckpt_reshards``.
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
@@ -51,11 +83,97 @@ class Checkpointer:
         self._retry_base = retry_base_delay
         self.stats = stats if stats is not None else ResilienceStats()
         self.restored_step: Optional[int] = None  # set by restore()
+        self._dir = os.path.abspath(directory)
+        self._digest_dir = os.path.join(self._dir, "digests")
+        # step -> saved leaf metadata, held until the async save lands and
+        # the digest manifest can be computed from the on-disk files.
+        self._pending_manifests: Dict[int, List[Optional[dict]]] = {}
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True),
         )
+
+    # ------------------------------------------------- integrity manifests
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._digest_dir, f"{step}.json")
+
+    def _read_manifest(self, step: int) -> Optional[dict]:
+        try:
+            with open(self._manifest_path(step)) as f:
+                m = json.load(f)
+            return m if isinstance(m, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _step_files(self, step: int) -> Dict[str, str]:
+        """relpath -> abspath for every file under the committed step dir."""
+        root = os.path.join(self._dir, str(step))
+        out = {}
+        for base, _, files in os.walk(root):
+            for fname in files:
+                p = os.path.join(base, fname)
+                out[os.path.relpath(p, root)] = p
+        return out
+
+    def _flush_manifests(self) -> None:
+        """Write digest manifests for landed saves; prune manifests of
+        steps the manager has since deleted (max_to_keep). Call only after
+        ``wait_until_finished`` — digests of in-flight files would be
+        digests of half-written bytes."""
+        live = set(self.all_steps())
+        for step in list(self._pending_manifests):
+            leaves = self._pending_manifests.pop(step)
+            if step not in live:
+                continue             # evicted before landing; nothing to do
+            try:
+                files = {rel: _sha256_file(p)
+                         for rel, p in self._step_files(step).items()}
+                os.makedirs(self._digest_dir, exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=self._digest_dir,
+                                           suffix=".json.tmp")
+                with os.fdopen(fd, "w") as f:
+                    json.dump({"version": MANIFEST_VERSION, "step": step,
+                               "files": files, "leaves": leaves}, f)
+                os.replace(tmp, self._manifest_path(step))
+            except OSError:
+                pass                 # integrity extras must not sink a save
+        try:
+            for name in os.listdir(self._digest_dir):
+                stem = name.partition(".")[0]
+                if stem.isdigit() and int(stem) not in live:
+                    os.unlink(os.path.join(self._digest_dir, name))
+        except OSError:
+            pass
+
+    def _verify_digests(self, step: int) -> Optional[str]:
+        """None if the step's files match its manifest (or no manifest
+        exists — legacy steps restore unverified); else a description of
+        the first mismatch.
+
+        Deliberately re-hashes even steps this process digested moments
+        ago in ``_flush_manifests``: the threat model is on-disk mutation
+        AFTER the bytes landed (bit rot, another process, an injected
+        fault between save and restore), and a skip-if-recently-hashed
+        fast path would be blind to exactly that window. The cost is one
+        extra read+hash per restored step in the save-then-restore-same-
+        process case (StepGuard rollback, elastic recovery)."""
+        manifest = self._read_manifest(step)
+        if manifest is None or not isinstance(manifest.get("files"), dict):
+            return None
+        on_disk = self._step_files(step)
+        for rel, want in manifest["files"].items():
+            p = on_disk.get(rel)
+            if p is None:
+                return f"missing shard file {rel!r}"
+            try:
+                got = _sha256_file(p)
+            except OSError as e:
+                return f"unreadable shard file {rel!r}: {e}"
+            if got != want:
+                return f"digest mismatch in {rel!r}"
+        return None
 
     def _count_retry(self, attempt: int, exc: BaseException) -> None:
         self.stats.retries += 1
@@ -84,13 +202,27 @@ class Checkpointer:
                     f"checkpoint step {step} already exists "
                     f"(pass overwrite=True to replace a stale entry)")
             self._mgr.delete(step)
-        return retry_call(
+            self._pending_manifests.pop(step, None)
+            try:
+                os.unlink(self._manifest_path(step))
+            except OSError:
+                pass
+        ok = retry_call(
             self._mgr.save, step, args=ocp.args.StandardSave(state),
             force=force, attempts=self._retry_attempts,
             base=self._retry_base, seed=step, on_retry=self._count_retry)
+        # Leaf metadata for the integrity/reshard manifest, captured NOW
+        # (shapes/dtypes only — no device sync); digests wait for the
+        # async write to land (_flush_manifests).
+        self._pending_manifests[step] = [
+            {"shape": list(x.shape), "dtype": str(x.dtype)}
+            if isinstance(x, jax.Array) else None
+            for x in jax.tree.leaves(state)]
+        return ok
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_manifests()
 
     def restore(self, template: Any, *, step: Optional[int] = None) -> Any:
         """Restore into ``template``'s structure, dtypes, and shardings.
@@ -98,13 +230,19 @@ class Checkpointer:
         ``template`` is a live pytree with the desired layout (typically a
         freshly built TrainState on the current mesh — its values are only
         read for shape/sharding). Defaults to the latest step; if that step
-        is corrupt/unreadable (truncated by a kill, garbled on disk), falls
-        back to the next-newest step that restores cleanly — each skipped
-        step counts into ``stats.ckpt_fallbacks``. An explicitly requested
-        ``step`` does NOT fall back: the caller named it, so failing loudly
-        is correct.
+        is corrupt/unreadable (truncated by a kill, garbled on disk, or
+        failing its digest manifest), falls back to the next-newest step
+        that restores cleanly — each skipped step counts into
+        ``stats.ckpt_fallbacks``. An explicitly requested ``step`` does NOT
+        fall back: the caller named it, so failing loudly is correct.
+
+        A step whose manifest records leaf shapes DIFFERENT from the
+        template's (saved at another data-parallel world size) is restored
+        at its saved shapes and resharded into the template — see the class
+        docstring's cross-topology contract.
         """
         self._mgr.wait_until_finished()   # flush any in-flight async save
+        self._flush_manifests()
 
         def abstract(x):
             if isinstance(x, jax.Array):
@@ -123,11 +261,29 @@ class Checkpointer:
                               if isinstance(t, jax.Array) else r),
                 restored, template)
 
-        if step is not None:
+        def restore_one(s: int):
+            bad = self._verify_digests(s)
+            if bad is not None:
+                raise ValueError(
+                    f"checkpoint step {s} failed integrity check: {bad}")
+            saved_target = self._saved_shape_target(s, template)
+            if saved_target is None:      # shapes match: the common case
+                return place(self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(target)))
+            # Cross-topology: restore at SAVED shapes (replicated), then
+            # pad-swap + rescatter into the template's mesh — never let
+            # orbax silently truncate into a smaller target.
+            from .parallel.dp import reshard_state
             restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(target))
+                s, args=ocp.args.StandardRestore(saved_target))
+            out = reshard_state(restored, template)
+            self.stats.ckpt_reshards += 1
+            return out
+
+        if step is not None:
+            restored = restore_one(step)
             self.restored_step = step  # only after the restore succeeded
-            return place(restored)
+            return restored
 
         candidates = sorted(self.all_steps(), reverse=True)
         if not candidates:
@@ -135,17 +291,47 @@ class Checkpointer:
         last_exc: Optional[BaseException] = None
         for s in candidates:
             try:
-                restored = self._mgr.restore(
-                    s, args=ocp.args.StandardRestore(target))
-            except Exception as e:  # corrupt/truncated/garbled step
+                restored = restore_one(s)
+            except Exception as e:  # corrupt/garbled/digest-failed step
                 last_exc = e
                 self.stats.ckpt_fallbacks += 1
                 continue
             self.restored_step = s  # which step actually won (≤ latest_step)
-            return place(restored)
+            return restored
         raise FileNotFoundError(
             f"all {len(candidates)} checkpoint steps failed to restore "
             f"(newest error: {last_exc!r})") from last_exc
+
+    def _saved_shape_target(self, step: int, template):
+        """An abstract restore target at the manifest's SAVED leaf shapes
+        (template structure, replicated sharding on the template's mesh) —
+        or None when shapes already match the template / no manifest
+        records them (legacy steps restore as before)."""
+        manifest = self._read_manifest(step)
+        leaves_meta = (manifest or {}).get("leaves")
+        t_leaves, treedef = jax.tree.flatten(template)
+        if (not isinstance(leaves_meta, list)
+                or len(leaves_meta) != len(t_leaves)):
+            return None
+        changed = False
+        out = []
+        for t, meta in zip(t_leaves, leaves_meta):
+            if not isinstance(t, jax.Array) or meta is None:
+                out.append(jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                                sharding=t.sharding)
+                           if isinstance(t, jax.Array) else t)
+                continue
+            shape = tuple(meta["shape"])
+            if shape == t.shape:
+                out.append(jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                                sharding=t.sharding))
+                continue
+            changed = True
+            mesh = getattr(t.sharding, "mesh", None)
+            repl = NamedSharding(mesh, P()) if mesh is not None else None
+            out.append(jax.ShapeDtypeStruct(shape, np.dtype(meta["dtype"]),
+                                            sharding=repl))
+        return jax.tree.unflatten(treedef, out) if changed else None
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -154,6 +340,11 @@ class Checkpointer:
         return list(self._mgr.all_steps())
 
     def close(self) -> None:
+        try:
+            self._mgr.wait_until_finished()
+            self._flush_manifests()
+        except Exception:
+            pass              # closing must succeed even on a broken disk
         self._mgr.close()
 
     def __enter__(self):

@@ -182,6 +182,18 @@ class ResilienceConfig:
     retry_base_delay: float = 0.1  # seconds; doubles per attempt, jittered
     faults: str = ""               # FaultPlan spec for injection runs
     fault_seed: int = 0            # drives every random fault choice
+    # Elastic data parallelism (resilience/elastic.py, DP trainer only):
+    # survive replica loss mid-run by draining at the chunk edge,
+    # re-meshing onto the survivors and resharding params + ZeRO-1
+    # optimizer state N→M. With zero faults the elastic loop's loss
+    # trajectory is bitwise the non-elastic one (tests/test_elastic.py).
+    elastic: bool = False
+    # Host-RAM last-good state mirror cadence, in chunk edges: 1 mirrors
+    # every edge (recovery replays nothing), k mirrors every k-th (cheaper
+    # steady state, up to k·steps_per_dispatch steps replayed on
+    # recovery), 0 disables the fast path (recovery goes through the
+    # checkpoint).
+    mirror_every: int = 1
 
     def fault_plan(self):
         """The configured FaultPlan (empty spec → empty plan)."""

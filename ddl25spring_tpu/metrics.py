@@ -78,6 +78,8 @@ class ResilienceStats:
     straggler_clients: int = 0   # FL: over-deadline clients excluded
     skipped_rounds: int = 0      # FL: rounds with zero surviving clients
     preemptions: int = 0         # SIGTERM force-save exits
+    remeshes: int = 0            # elastic: replica-loss re-mesh recoveries
+    ckpt_reshards: int = 0       # cross-topology checkpoint restores
 
     def as_dict(self) -> dict:
         return {k: int(v) for k, v in self.__dict__.items()}

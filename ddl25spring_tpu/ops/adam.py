@@ -31,7 +31,43 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+
+
+def resize_zero_padded(vec, new_len: int):
+    """Resize a ZeRO-1 padded flat vector (params / Adam mu / Adam nu slice
+    stack) from its N-way padded length to an M-way padded length — the
+    elementwise core of cross-topology optimizer-state resharding
+    (resilience/elastic.py, checkpoint reshard-on-load).
+
+    Valid because the pad region of every ZeRO-1 flat vector is EXACTLY
+    zero, forever: the padded gradient tail is zero by construction
+    (``jnp.pad`` in ``parallel/dp.py``), so mu/nu at pad coordinates stay
+    ``b·0 + (1−b)·0 = 0`` and the padded param tail steps by
+    ``−lr·(0/c1)/(√(0/c2)+ε) = 0`` under every elementwise rule in this
+    module. Truncating the tail therefore loses nothing and extending it
+    appends the zeros a larger pad would have carried — the resized vector
+    is bit-identical to the one an M-way ``_zero1_setup`` would have built
+    from the same unpadded content. A non-zero truncated tail means the
+    vector is NOT a zero-padded slice stack (layout bug or corrupted
+    state), and silently dropping real data would poison the run — hard
+    error instead."""
+    vec = np.asarray(vec)
+    if vec.ndim != 1:
+        raise ValueError(f"resize_zero_padded wants a flat vector, got "
+                         f"shape {vec.shape}")
+    if new_len == vec.shape[0]:
+        return vec
+    if new_len < vec.shape[0]:
+        tail = vec[new_len:]
+        if tail.any():
+            raise ValueError(
+                f"cannot truncate {vec.shape[0]} -> {new_len}: tail is not "
+                f"all-zero (max |tail| = {np.abs(tail).max()}) — not a "
+                "zero-padded ZeRO-1 vector")
+        return vec[:new_len]
+    return np.concatenate([vec, np.zeros(new_len - vec.shape[0], vec.dtype)])
 
 
 def apply_optimizer(optimizer, grads, opt_state, params):

@@ -30,6 +30,7 @@ from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -422,6 +423,51 @@ def make_zero1_multi_step(loss_fn: Callable,
         out_specs=(TrainState(P(), opt_specs, P()), P()),
         check_vma=False)
     return state, jax.jit(step, donate_argnums=(0,))
+
+
+def reshard_state(host_state, template_state):
+    """Cross-topology state resharding: place a host-RAM TrainState snapshot
+    (numpy leaves — e.g. an elastic controller's last-good mirror, or a
+    checkpoint restored at its saved shapes) into ``template_state``'s
+    layout, which may live on a DIFFERENT-SIZE mesh than the snapshot was
+    taken on.
+
+    Leaf rule: equal shapes re-place as-is into the template's sharding
+    (replicated params land on every survivor; scalars replicate); a flat
+    vector whose length differs is an N-way ZeRO-1 padded slice stack
+    (params/mu/nu over the old ``data`` axis) and is resized to the M-way
+    padded length via ``ops.adam.resize_zero_padded`` — the
+    all-gather-then-rescatter: the host copy IS the gather, the resize
+    swaps the pad, and the ``device_put`` against the template's
+    ``P("data")`` sharding is the rescatter. Zero-pad-tail violations are
+    a hard error there, not silent truncation.
+
+    Value-exact by construction: every surviving coordinate is a bitwise
+    copy, so a trajectory continued from the resharded state is the
+    trajectory of a fresh M-way run initialized from the same snapshot
+    (asserted in tests/test_elastic.py)."""
+    from ..ops.adam import resize_zero_padded
+
+    def leaf(h, t):
+        if not isinstance(t, jax.Array):
+            return h
+        h = np.asarray(h)
+        if h.shape != t.shape:
+            h = resize_zero_padded(h, t.shape[0] if t.ndim == 1 else -1)
+        return jax.device_put(h, t.sharding)
+
+    return jax.tree.map(leaf, host_state, template_state)
+
+
+def host_snapshot(state):
+    """Full host-RAM copy of a (possibly sharded) TrainState — the gather
+    half of elastic recovery's fast path. ``np.asarray`` on a sharded
+    global array materializes the whole array on host (single-process),
+    so ZeRO-1 moment slices from EVERY replica land in the mirror — which
+    is what makes recovery onto fewer replicas possible after some of
+    those slices' owners die."""
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state)
 
 
 def shard_batch(mesh: Mesh, batch) -> jax.Array:

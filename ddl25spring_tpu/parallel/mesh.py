@@ -53,6 +53,33 @@ def make_mesh(axis_sizes: Optional[Dict[str, int]] = None, *,
     return Mesh(dev_array, tuple(names))
 
 
+def survivor_submesh(mesh: Mesh, lost: Sequence[int]) -> Mesh:
+    """The mesh that remains after losing data-axis replicas ``lost`` —
+    elastic DP's re-mesh step (resilience/elastic.py). Surviving devices
+    keep their relative order, so replica ``i`` of the new mesh is the
+    ``i``-th survivor of the old one.
+
+    Data-axis-only meshes for now: dropping a replica from a multi-axis
+    mesh (DPxPP, DPxTP) would orphan the lost replica's stage/model
+    partners, a genuinely different recovery problem (their shards are
+    intact and must be re-wired, not resharded)."""
+    for name, size in mesh.shape.items():
+        if name != "data" and size > 1:
+            raise ValueError(
+                f"survivor_submesh supports data-axis-only meshes; "
+                f"axis {name!r} has size {size}")
+    n = mesh.shape.get("data", 1)
+    lost = sorted(set(int(i) for i in lost))
+    if any(i < 0 or i >= n for i in lost):
+        raise ValueError(f"lost replicas {lost} out of range for data={n}")
+    if len(lost) >= n:
+        raise ValueError(f"losing {len(lost)} of {n} replicas leaves no "
+                         "survivors — nothing to re-mesh onto")
+    devices = [d for i, d in enumerate(mesh.devices.flatten())
+               if i not in lost]
+    return Mesh(np.asarray(devices), ("data",))
+
+
 def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 

@@ -12,6 +12,12 @@ clients):
 - ``retry``      — exponential backoff with seeded jitter, applied to
                    checkpoint IO and native tokenstream loading.
 - ``preemption`` — SIGTERM → force-save-resumable-checkpoint → clean exit.
+- ``elastic``    — ``ElasticController``: replica loss (``device_loss``
+                   faults → ``ReplicaLossError``) → drain at the chunk
+                   edge, re-mesh onto the survivors, reshard params +
+                   ZeRO-1 optimizer state N→M, re-split the stream,
+                   resume — from a host-RAM mirror (fast) or the
+                   checkpoint (slow).
 
 Counters land in ``metrics.ResilienceStats``; knobs in
 ``config.ResilienceConfig``. Wire-ins: train/llm.py (guarded loops),
@@ -20,16 +26,20 @@ guard), checkpoint.py (corrupt-step fallback, atomic best-weights),
 experiments/watchdog.py (crash-loop-aware relaunch backoff).
 """
 
-from .faults import (FaultEvent, FaultPlan,  # noqa: F401
+from .elastic import (ElasticController, RemeshRecord,  # noqa: F401
+                      Resume)
+from .faults import (FaultEvent, FaultPlan, ReplicaLossError,  # noqa: F401
                      corrupt_latest_checkpoint, parse_spec)
 from .preemption import PreemptionHandler  # noqa: F401
 from .retry import backoff_schedule, retry_call, with_retry  # noqa: F401
 
-# guard imports jax at module scope; everything above is numpy/stdlib-only.
+# guard imports jax at module scope; everything above is numpy/stdlib-only
+# (elastic defers its parallel/ imports into recover()).
 # Load it lazily (PEP 562) so jax-free supervisors — experiments/watchdog.py
 # pulling in backoff_schedule — don't pay jax's import time and memory.
 _GUARD_EXPORTS = ("StepGuard", "measure_overhead")
-__all__ = ["FaultEvent", "FaultPlan", "corrupt_latest_checkpoint",
+__all__ = ["ElasticController", "FaultEvent", "FaultPlan", "RemeshRecord",
+           "ReplicaLossError", "Resume", "corrupt_latest_checkpoint",
            "parse_spec", "PreemptionHandler", "backoff_schedule",
            "retry_call", "with_retry", *_GUARD_EXPORTS]
 

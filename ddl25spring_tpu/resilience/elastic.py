@@ -1,0 +1,242 @@
+"""Elastic data parallelism: survive replica loss mid-run.
+
+PR 1's resilience layer heals runs whose *topology never changes* — bad
+steps are skipped, corrupt checkpoints rolled past, SIGTERM resumed. This
+module removes that assumption for the DP trainer: when a data-parallel
+replica dies mid-run (injected via the ``device_loss`` FaultPlan kind, or
+any caller raising ``ReplicaLossError``), the run drains at the chunk
+edge, re-meshes onto the survivors, reshards params + N-way ZeRO-1
+optimizer state to the M-way layout, re-splits the batch stream at the
+exact stream position, and resumes — instead of dying with the replica.
+ZeRO-1 (PR 3) is what makes this non-trivial: optimizer moments are
+physically sharded N ways, so 1/N of them lived on the dead replica and
+recovery onto M survivors is genuine cross-topology state resharding
+(all-gather-then-rescatter, ``parallel.dp.reshard_state``), not a restart.
+
+Recovery paths, fastest first:
+
+- **mirror** (fast): a host-RAM last-good snapshot taken at chunk edges
+  (``ResilienceConfig.mirror_every``). The snapshot IS the all-gather —
+  ``np.asarray`` on each sharded leaf materializes every replica's slice
+  on host — so recovery is a pad-swap + device_put onto the survivors.
+  With ``mirror_every=1`` nothing is replayed.
+- **checkpoint** (slow): no mirror → restore the newest valid step through
+  ``Checkpointer``'s cross-topology path (saved-shape restore + reshard on
+  load), then re-train forward from it.
+
+Either way the recovered state is persisted back to the checkpoint dir in
+the NEW layout immediately (a second failure must not redo the
+cross-topology work), the stream is rebuilt at width M and replayed to the
+recovery position (a fresh M-replica run's data order, exactly), and the
+step function is rebuilt at the new world size with fault/guard wrappers
+re-applied at the absolute dispatch index.
+
+Correctness bar (pinned in tests/test_elastic.py): bitwise. Zero faults →
+the elastic loop's losses equal the non-elastic path's; after an N→M
+shrink the continued trajectory equals a fresh M-replica run restored
+from the same state.
+
+Scope: DP-only meshes (gradient / zero1 aggregation). Losing a replica
+from a DPxPP/DPxTP mesh orphans the victim's stage/model partners — a
+re-wiring problem, not a resharding one — and is rejected loudly
+(``parallel.mesh.survivor_submesh``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, NamedTuple, Optional, Tuple
+
+from .faults import ReplicaLossError
+
+
+@dataclass
+class RemeshRecord:
+    """Accounting for one replica-loss recovery — lands in
+    ``LLMTrainReport.remeshes``, the telemetry ``remesh`` event, and the
+    elastic smoke's recovery JSON."""
+
+    detected_at: int       # stream position of the failed dispatch
+    resume_step: int       # stream position training resumed from
+    dispatch: int          # absolute dispatch index of the failure
+    old_world: int
+    new_world: int
+    lost: List[int] = field(default_factory=list)
+    path: str = "mirror"   # "mirror" (host-RAM fast path) | "checkpoint"
+    seconds: float = 0.0   # drain → resharded-and-replayed wall time
+    steps_replayed: int = 0  # detected_at - resume_step (re-trained steps)
+
+    def as_dict(self) -> dict:
+        return {"detected_at": self.detected_at,
+                "resume_step": self.resume_step,
+                "dispatch": self.dispatch,
+                "old_world": self.old_world, "new_world": self.new_world,
+                "lost": list(self.lost), "path": self.path,
+                "seconds": self.seconds,
+                "steps_replayed": self.steps_replayed}
+
+
+class Resume(NamedTuple):
+    """What the training loop swaps in after a recovery."""
+    mesh: Any
+    n_data: int
+    state: Any
+    step_fn: Callable
+    window_shard_fn: Callable
+    batches: Any           # stream iterator, already replayed to ``step``
+    step: int              # stream position to resume from
+    record: RemeshRecord
+
+
+class ElasticController:
+    """The drain → re-mesh → reshard → resume state machine.
+
+    The training loop owns the iteration; the controller owns everything
+    topology: the host-RAM mirror, victim selection, survivor submesh
+    construction, state resharding, stream re-split/replay, step-function
+    rebuild, and recovery accounting. Wiring (train/llm.py):
+
+    - ``build(mesh) -> (template_state, raw_step_fn, window_shard_fn)``
+      builds the trainer's window step on an arbitrary data mesh; the
+      template's freshly initialized state supplies the M-way
+      shapes/shardings recovery reshards into.
+    - ``rewrap(raw_step_fn, start) -> step_fn`` re-applies the fault plan
+      (at absolute dispatch index ``start`` — already-fired faults must
+      not re-fire) and a fresh StepGuard (its EMA detector re-warms on
+      the new topology's update norms).
+    - ``make_batches(n_shards) -> iterator`` rebuilds the stream at the
+      new width; the controller replays it to the recovery position so
+      the data order is exactly a fresh M-replica run's.
+
+    ``note_edge(step, state)`` is the loop's post-dispatch hook: every
+    ``mirror_every``-th chunk edge it refreshes the host mirror (one
+    device→host sync of the full state; ``mirror_every=0`` disables the
+    fast path). ``recover(err, ...)`` runs the state machine and returns a
+    ``Resume``; it raises ``err`` back when recovery is impossible (no
+    mirror AND no restorable checkpoint).
+    """
+
+    def __init__(self, mesh, *, build: Callable, rewrap: Callable,
+                 make_batches: Callable, ckpt=None, mirror_every: int = 1,
+                 stats=None, telemetry=None, log_fn: Callable = print):
+        self.mesh = mesh
+        self._build = build
+        self._rewrap = rewrap
+        self._make_batches = make_batches
+        self._ckpt = ckpt
+        self.mirror_every = int(mirror_every)
+        self._stats = stats
+        self._telemetry = telemetry
+        self._log = log_fn
+        self._mirror: Optional[Tuple[int, Any]] = None  # (step, host state)
+        self._edges = 0
+        self.records: List[RemeshRecord] = []
+
+    # ------------------------------------------------------------- mirror
+
+    def note_edge(self, step: int, state) -> None:
+        """Chunk-edge hook: refresh the last-good host mirror on schedule.
+        The first call (the loop's pre-training seed at ``start_step``)
+        always mirrors, so a loss on the very first dispatch is
+        recoverable without a checkpoint."""
+        if self.mirror_every <= 0:
+            return
+        if self._mirror is None or self._edges % self.mirror_every == 0:
+            from ..parallel import dp
+            self._mirror = (step, dp.host_snapshot(state))
+        self._edges += 1
+
+    @property
+    def mirror_step(self) -> Optional[int]:
+        return self._mirror[0] if self._mirror is not None else None
+
+    # ----------------------------------------------------------- recovery
+
+    def recover(self, err: ReplicaLossError, *, failed_at: int,
+                dispatch: int) -> Resume:
+        """Re-mesh onto the survivors and hand back a resumable world.
+
+        ``failed_at`` is the stream position of the dispatch that died
+        (its first step index); ``dispatch`` its absolute dispatch index —
+        the rebuilt fault wrapper continues the schedule from
+        ``dispatch + 1``, so already-delivered faults never re-fire and
+        later-scheduled ones keep their absolute positions."""
+        from ..parallel import dp
+        from ..parallel.mesh import survivor_submesh
+
+        t0 = time.perf_counter()
+        old_world = int(self.mesh.shape["data"])
+        lost = err.victims(old_world)
+        if not lost:
+            # A 1-replica world has no survivors to re-mesh onto (victims'
+            # ≥1-survivor clamp returns empty there): the loss is the whole
+            # run, and pretending otherwise would be a vacuous "recovery"
+            # onto the dead replica itself.
+            raise err
+        new_mesh = survivor_submesh(self.mesh, lost)
+        new_world = old_world - len(lost)
+        self._log(f"replica loss at step {failed_at} (dispatch {dispatch}): "
+                  f"lost {lost} of {old_world}; re-meshing onto "
+                  f"{new_world} survivors")
+        self._beat(failed_at, "remesh")
+
+        template, raw_step, window_shard = self._build(new_mesh)
+        if self._mirror is not None:
+            resume_step, host_state = self._mirror
+            state = dp.reshard_state(host_state, template)
+            path = "mirror"
+        elif self._ckpt is not None:
+            try:
+                state = self._ckpt.restore(template)
+            except FileNotFoundError:
+                raise err from None     # nothing recoverable on disk either
+            resume_step = int(self._ckpt.restored_step)
+            path = "checkpoint"
+        else:
+            raise err                   # no mirror, no checkpoint: fatal
+
+        if self._ckpt is not None:
+            # Persist the M-way layout NOW: a second loss (or a plain
+            # preemption) must restore cross-topology work, not redo it.
+            # overwrite: step ``resume_step`` on disk is the N-way lineage.
+            self._ckpt.save(resume_step, state, force=True, overwrite=True)
+
+        batches = self._make_batches(new_world)
+        last_beat = 0.0
+        for i in range(resume_step):    # stream replay at the new width
+            next(batches)
+            now = time.perf_counter()
+            if now - last_beat >= 0.5:
+                self._beat(i, "remesh")
+                last_beat = now
+
+        step_fn = self._rewrap(raw_step, start=dispatch + 1)
+        self.mesh = new_mesh
+        self._edges = 0
+        self._mirror = None
+        if self.mirror_every > 0:
+            self.note_edge(resume_step, state)
+
+        rec = RemeshRecord(
+            detected_at=failed_at, resume_step=resume_step,
+            dispatch=dispatch, old_world=old_world, new_world=new_world,
+            lost=lost, path=path, seconds=time.perf_counter() - t0,
+            steps_replayed=failed_at - resume_step)
+        self.records.append(rec)
+        if self._stats is not None:
+            self._stats.remeshes += 1
+        if self._telemetry is not None:
+            self._telemetry.events.remesh(
+                old_world=old_world, new_world=new_world, lost=lost,
+                path=path, it=resume_step, detected_at=failed_at,
+                seconds=rec.seconds, steps_replayed=rec.steps_replayed)
+        self._log(f"re-mesh complete in {rec.seconds:.3f}s via {path}: "
+                  f"resuming at step {resume_step} "
+                  f"({rec.steps_replayed} steps to re-train)")
+        return Resume(new_mesh, new_world, state, step_fn, window_shard,
+                      batches, resume_step, rec)
+
+    def _beat(self, step: int, phase: str) -> None:
+        if self._telemetry is not None:
+            self._telemetry.heartbeat.beat(step=step, phase=phase)

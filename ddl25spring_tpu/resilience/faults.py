@@ -11,7 +11,11 @@ It can, at chosen steps/rounds:
   round — the servers re-weight aggregation over the survivors;
 - corrupt the newest checkpoint on disk (`corrupt_latest_checkpoint`);
 - deliver a simulated preemption (``preempt``: SIGTERM to this process) at
-  a step boundary.
+  a step boundary;
+- kill data-parallel replicas (``device_loss``: the wrapped step raises
+  ``ReplicaLossError`` instead of dispatching, modeling the dispatch dying
+  with the device — resilience/elastic.py turns it into a re-mesh onto the
+  survivors).
 
 Plans parse from a compact spec string so bench.py / experiments can take
 them straight off a CLI flag or config field::
@@ -21,6 +25,8 @@ them straight off a CLI flag or config field::
     "preempt@25"                  SIGTERM delivered before step 25
     "drop_client@3:2"             2 clients vanish in round 3
     "delay_client@1:1"            1 client straggles past deadline, round 1
+    "device_loss@4"               1 DP replica dies at dispatch 4
+    "device_loss@4:2"             2 DP replicas die at dispatch 4
     "nan_grad@10,preempt@25"      comma-composed
 
 Determinism contract: the same (spec, seed) always injects the same faults
@@ -39,7 +45,38 @@ import numpy as np
 
 GRAD_FAULTS = ("nan_grad", "inf_grad", "spike_grad")
 CLIENT_FAULTS = ("drop_client", "delay_client")
-KINDS = GRAD_FAULTS + CLIENT_FAULTS + ("preempt", "corrupt_ckpt")
+KINDS = GRAD_FAULTS + CLIENT_FAULTS + ("preempt", "corrupt_ckpt",
+                                       "device_loss")
+
+
+class ReplicaLossError(RuntimeError):
+    """A data-parallel replica (device) died at dispatch ``step``.
+
+    Raised by ``FaultPlan.wrap_step`` in place of running the scheduled
+    dispatch — the injection-side model of a device failure surfacing as a
+    failed dispatch. Anything that raises this (a real backend failure
+    translated by a caller counts too) triggers the elastic recovery path
+    when an ``ElasticController`` is attached (resilience/elastic.py);
+    without one it propagates and kills the run, which is exactly today's
+    non-elastic behavior.
+
+    ``victims(n)`` picks WHICH of the ``n`` current replicas died — a
+    seeded deterministic choice (same (seed, step) → same victims, the
+    FaultPlan determinism contract), always leaving at least one survivor.
+    """
+
+    def __init__(self, step: int, count: int = 1, seed: int = 0):
+        super().__init__(f"replica loss at dispatch {step} "
+                         f"({count} replica{'s' if count != 1 else ''})")
+        self.step = int(step)
+        self.count = max(1, int(count))
+        self.seed = int(seed)
+
+    def victims(self, n: int) -> List[int]:
+        k = min(self.count, n - 1)
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.step, len(KINDS)]))
+        return sorted(int(i) for i in rng.choice(n, size=k, replace=False))
 
 
 @dataclass(frozen=True)
@@ -131,12 +168,22 @@ class FaultPlan:
 
     # --------------------------------------------------------- injection
 
-    def wrap_step(self, step_fn, stats=None):
-        """Wrap ``step_fn(state, batch) -> (state, loss)`` so grad faults and
-        simulated preemptions fire at their scheduled steps.
+    def device_loss_at(self, step: int) -> Optional[FaultEvent]:
+        return self._at(("device_loss",), step)
+
+    def wrap_step(self, step_fn, stats=None, *, start: int = 0):
+        """Wrap ``step_fn(state, batch) -> (state, loss)`` so grad faults,
+        simulated preemptions and replica losses fire at their scheduled
+        steps.
 
         The wrapper counts calls itself (step indices are call indices from
-        the wrap point). Gradient faults poison the *outputs* exactly as the
+        the wrap point; ``start`` offsets the counter so a step function
+        REBUILT mid-run — elastic re-mesh — keeps absolute dispatch
+        indices, instead of re-firing already-delivered faults from 0).
+        ``device_loss`` raises ``ReplicaLossError`` BEFORE the step runs —
+        the dispatch dies with the device, the incoming state buffers are
+        untouched (donation never happened), and the elastic layer decides
+        what survives. Gradient faults poison the *outputs* exactly as the
         corrupted gradient would have: ``nan_grad``/``inf_grad`` make every
         updated param and the loss NaN/Inf (any standard optimizer update
         propagates a non-finite gradient into every touched coordinate);
@@ -152,11 +199,15 @@ class FaultPlan:
 
         from .guard import _tree_copy
 
-        counter = {"step": 0}
+        counter = {"step": start}
 
         def wrapped(state, batch):
             step = counter["step"]
             counter["step"] += 1
+            dl = self.device_loss_at(step)
+            if dl is not None:
+                raise ReplicaLossError(step, int(dl.arg) if dl.arg else 1,
+                                       seed=self.seed)
             if self.preempt_at(step):
                 os.kill(os.getpid(), signal.SIGTERM)
             e = self.grad_fault_at(step)

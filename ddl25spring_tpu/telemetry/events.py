@@ -37,7 +37,7 @@ SCHEMA_VERSION = 1
 # Event types this schema version defines. Emitters may add new types
 # freely; ``validate_event`` checks base fields for ALL types and the
 # per-type required fields only for the known ones.
-EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end")
+EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -46,6 +46,11 @@ _REQUIRED: Dict[str, tuple] = {
     "fault": ("counters",),
     "fl_round": ("round",),
     "run_end": ("steps",),
+    # Elastic re-mesh recovery (resilience/elastic.py): replica loss →
+    # survivor submesh + cross-topology state reshard. Carries old/new
+    # world size plus path taken ("mirror"/"checkpoint"), seconds lost,
+    # and steps replayed; rendered by experiments/obs_report.py.
+    "remesh": ("old_world", "new_world"),
 }
 
 
@@ -172,6 +177,11 @@ class EventLog:
 
     def run_end(self, *, steps: int, **fields) -> Dict[str, Any]:
         return self.emit("run_end", steps=steps, **fields)
+
+    def remesh(self, *, old_world: int, new_world: int,
+               **fields) -> Dict[str, Any]:
+        return self.emit("remesh", old_world=old_world, new_world=new_world,
+                         **fields)
 
     def close(self) -> None:
         with self._lock:

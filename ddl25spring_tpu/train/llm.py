@@ -45,6 +45,12 @@ class LLMTrainReport:
     preempted: bool = False
     start_step: int = 0
     resilience: Optional[ResilienceStats] = None
+    # Elastic mode (resilience/elastic.py): one dict per replica-loss
+    # recovery (RemeshRecord.as_dict — old/new world, path, seconds,
+    # steps replayed), and the throughput measured on the final topology
+    # (0.0 when no remesh happened or too little ran after the last one).
+    remeshes: List[dict] = field(default_factory=list)
+    post_remesh_tokens_per_sec: float = 0.0
 
     def tokens_per_sec_per_device(self, n_devices: int) -> float:
         return self.tokens_per_sec / max(n_devices, 1)
@@ -144,7 +150,7 @@ def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
 
 def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
                    mesh, start_step: int, step_fn, state, n_data: int,
-                   steps_per_dispatch: int = 1) -> None:
+                   steps_per_dispatch: int = 1, windowed: bool = False) -> None:
     """Open a telemetry run: one manifest event carrying the configuration
     and the step's static communication profile (telemetry/comm.py —
     measured by abstract tracing BEFORE the first real call, so the trace
@@ -161,7 +167,9 @@ def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
     comm_profile = None
     try:
         batch_shape = (n_data * train_cfg.batch_size, train_cfg.seq_len)
-        if steps_per_dispatch > 1:
+        if steps_per_dispatch > 1 or windowed:
+            # ``windowed``: the elastic loop drives the [K, B, T] window
+            # step even at K=1, so the trace needs the leading step axis.
             batch_shape = (steps_per_dispatch,) + batch_shape
         batch_sds = jax.ShapeDtypeStruct(batch_shape, jnp.int32)
         profile = measure_comm(step_fn, state, batch_sds)
@@ -372,6 +380,10 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                                "continuing")
     else:
         # ------------------------------------------------- chunked mode
+        # NOTE: _run_elastic_loop mirrors this block (plus the recovery
+        # path) and its zero-fault contract is BITWISE equality with it —
+        # a cadence/staging/checkpoint-edge change here must land there
+        # too (tests/test_elastic.py pins the equality).
         K = steps_per_dispatch
         chunks = []
         edge = start_step
@@ -482,17 +494,270 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     return report
 
 
+def _run_elastic_loop(controller, step_fn, state, batches,
+                      train_cfg: TrainConfig, *, n_data: int,
+                      start_step: int, ckpt, checkpoint_every: int,
+                      loss_sink, sink_every: int, log_every: int, log_fn,
+                      warmup_steps_excluded: int,
+                      stats: Optional[ResilienceStats] = None,
+                      telemetry=None, steps_per_dispatch: int = 1,
+                      window_shard_fn=None) -> LLMTrainReport:
+    """The chunked training loop (``_run_loop`` chunked mode) with a
+    replica-loss recovery path threaded through it: every dispatch runs
+    under a ``ReplicaLossError`` catch, every chunk edge feeds the
+    controller's host-RAM mirror, and a caught loss drains the in-flight
+    work, hands the world to ``ElasticController.recover`` and swaps in
+    the survivors' mesh/state/step/stream before continuing.
+
+    Zero-fault contract: the loss trajectory is bitwise the non-elastic
+    path's — the step functions come from the same factories, the windows
+    from the same stream arithmetic; the elastic extras (mirror sync at
+    chunk edges, the try/except) never touch the numerics
+    (tests/test_elastic.py pins it).
+
+    Bookkeeping under recovery: step indices stay stream positions. A
+    recovery that rewinds to mirror/checkpoint position ``m < failed_at``
+    re-trains steps ``m..`` on the new topology with the new topology's
+    stream — the loss record and CSV rows for those positions are
+    REWRITTEN (``report.losses`` truncates to ``m``; sink rows follow the
+    resume convention: later rows win), because the new-world trajectory
+    is the run's trajectory from ``m`` on. Chunk edges stay absolute
+    multiples of K, so a non-aligned recovery point realigns with one
+    smaller chunk exactly like a non-aligned resume. Throughput:
+    ``tokens_per_sec`` counts each topology's tokens at its own width
+    (wall time includes recovery, honestly); ``post_remesh_tokens_per_sec``
+    times the final topology from its first post-recovery synced chunk."""
+    from ..resilience.faults import ReplicaLossError
+
+    report = LLMTrainReport()
+    report.start_step = start_step
+    report.resilience = stats if stats is not None else ResilienceStats()
+    spans = Spans()
+    K = max(1, steps_per_dispatch)
+    last_event_t = time.perf_counter()
+    last_event_it = start_step - 1
+    last_replay_beat = -math.inf
+    prev_counters = report.resilience.as_dict()
+    last_saved = -1
+    t_start = None
+    excluded_steps = warmup_steps_excluded
+    timed_tokens = 0.0            # tokens after the warmup sync, per-width
+    phase_t0 = None               # current-topology timer (post-remesh)
+    phase_tokens = 0.0
+    pending = []                  # (first step index, [k] device losses)
+
+    def _flush_losses():
+        for it0, ls in pending:
+            for j, v in enumerate(np.atleast_1d(np.asarray(ls))):
+                i, v = it0 + j, float(v)
+                report.losses.append(v)
+                if loss_sink is not None and (i % sink_every == 0
+                                              or i == train_cfg.iters - 1):
+                    loss_sink(i, v)
+        pending.clear()
+
+    def _window(it0, it1):
+        # Reads n_data/batches from the enclosing frame so a recovery's
+        # rebinding re-points it at the survivors' stream automatically.
+        with spans("data"):
+            return np.stack([
+                next(batches).reshape(n_data * train_cfg.batch_size,
+                                      train_cfg.seq_len)
+                for _ in range(it1 - it0)])
+
+    preempt = PreemptionHandler()
+    last_it = start_step - 1
+
+    def _force_save(at: int) -> None:
+        if ckpt is not None:
+            if at not in (last_saved, start_step):
+                ckpt.save(at, state, force=True, overwrite=True)
+            ckpt.wait()
+        report.preempted = True
+        report.resilience.preemptions += 1
+        log_fn(f"preempted at iter {at}: checkpoint "
+               f"{'force-saved' if ckpt is not None else 'not saved'}"
+               f"{'' if ckpt is not None else ' (no checkpoint dir)'}")
+
+    with preempt:
+        for rep in range(start_step):   # resume: replay the stream
+            next(batches)
+            if telemetry is not None:
+                now = time.perf_counter()
+                if now - last_replay_beat >= 0.5:
+                    telemetry.heartbeat.beat(step=rep, phase="replay")
+                    last_replay_beat = now
+        # Seed the mirror with the initial state: a loss on the very
+        # first dispatch must be recoverable without a checkpoint.
+        controller.note_edge(start_step, state)
+        edge = start_step
+        staged = None               # (first step index, host window)
+        last_flush_edge = start_step
+        dispatch_idx = 0
+        while edge < train_cfg.iters:
+            if preempt.requested:
+                _force_save(edge)
+                break
+            it0, it1 = edge, min(train_cfg.iters, (edge // K + 1) * K)
+            if staged is not None and staged[0] == it0:
+                window = staged[1]
+            else:
+                window = _window(it0, it1)
+            staged = None
+            t_iter = time.perf_counter()
+            this_dispatch, dispatch_idx = dispatch_idx, dispatch_idx + 1
+            try:
+                with spans("dispatch"):
+                    state, losses = step_fn(state,
+                                            window_shard_fn(window))
+            except ReplicaLossError as err:
+                with spans("recover"):
+                    # Drain: settle in-flight work AND keep the host
+                    # copies — the device arrays belong to the dead
+                    # topology, and a flush after recovery must not
+                    # re-read buffers a real backend failure took away.
+                    pending[:] = [(i0, np.asarray(ls))
+                                  for i0, ls in pending]
+                    resume = controller.recover(err, failed_at=it0,
+                                                dispatch=this_dispatch)
+                n_data = resume.n_data
+                state, step_fn = resume.state, resume.step_fn
+                window_shard_fn, batches = resume.window_shard_fn, \
+                    resume.batches
+                m = resume.step
+                pending[:] = [p for p in pending if p[0] < m]
+                # The loss record indexes from report.start_step; a slow-
+                # path rewind can land BELOW it (digest-failed newest step
+                # → older checkpoint), in which case the record now begins
+                # at m and start_step must follow or every consumer
+                # (hw1b's sink rows, report.steps) mislabels by the gap.
+                del report.losses[max(0, m - report.start_step):]
+                report.start_step = min(report.start_step, m)
+                report.remeshes.append(resume.record.as_dict())
+                # Rewind the progress cursor too: steps in [m, failed_at)
+                # were discarded with the dead topology, and a preemption
+                # landing before they are re-trained must report/force-save
+                # position m, not the rolled-back high-water mark.
+                last_it = m - 1
+                last_flush_edge = min(last_flush_edge, m)
+                last_event_t = time.perf_counter()
+                last_event_it = m - 1
+                phase_t0, phase_tokens = None, 0.0
+                edge = m
+                continue
+            tokens_per_step = (n_data * train_cfg.batch_size
+                               * train_cfg.seq_len)
+            last_it = it1 - 1
+            first_chunk = t_start is None
+            pending.append((it0, losses))
+            if it1 < train_cfg.iters:
+                # Stage the NEXT chunk's host window while the device runs
+                # this one (same overlap as the non-elastic chunked loop);
+                # a recovery discards it — wrong width, wrong stream.
+                nxt = min(train_cfg.iters, (it1 // K + 1) * K)
+                staged = (it1, _window(it1, nxt))
+            if log_every:
+                for i in range(it0, it1):
+                    if i % log_every == 0:
+                        log_fn(f"iter {i}: "
+                               f"loss {float(losses[i - it0]):.4f}")
+            if telemetry is not None:
+                telemetry.registry.observe(
+                    "host_iter_s", time.perf_counter() - t_iter)
+                telemetry.heartbeat.beat(step=last_it)
+                if (last_it - last_event_it >= telemetry.step_every
+                        or it1 == train_cfg.iters):
+                    now = time.perf_counter()
+                    extra = {"steps_per_dispatch": it1 - it0}
+                    if first_chunk or (report.remeshes
+                                       and phase_t0 is None):
+                        extra["warmup"] = True  # compile / re-mesh compile
+                    telemetry.events.step(
+                        it=last_it, loss=float(losses[-1]),
+                        dt_s=now - last_event_t,
+                        steps=last_it - last_event_it, **extra)
+                    last_event_t, last_event_it = now, last_it
+                delta = report.resilience.delta(prev_counters)
+                if delta:
+                    telemetry.events.fault(counters=delta, it=last_it)
+                    prev_counters = report.resilience.as_dict()
+            if first_chunk:
+                float(losses[-1])   # sync: compile/replay stay untimed
+                t_start = time.perf_counter()
+                excluded_steps = it1 - it0
+                last_event_t, last_event_it = t_start, last_it
+                if not report.remeshes:
+                    phase_t0 = t_start
+            elif phase_t0 is None:
+                # First completed chunk on a new topology: its dt is
+                # dominated by the re-mesh recompile; sync and start the
+                # post-remesh throughput window after it.
+                float(losses[-1])
+                phase_t0 = time.perf_counter()
+            else:
+                timed_tokens += (it1 - it0) * tokens_per_step
+                phase_tokens += (it1 - it0) * tokens_per_step
+            controller.note_edge(it1, state)   # last-good mirror refresh
+            if (it1 - last_flush_edge >= sink_every
+                    or it1 == train_cfg.iters):
+                _flush_losses()
+                last_flush_edge = it1
+            if ckpt is not None and (it1 // checkpoint_every
+                                     ) > (it0 // checkpoint_every):
+                try:
+                    with spans("checkpoint"):
+                        ckpt.save(it1, state, overwrite=True)
+                    last_saved = it1
+                except Exception as e:
+                    log_fn(f"periodic checkpoint at {it1} failed after "
+                           f"retries ({type(e).__name__}: {e}); "
+                           "continuing")
+            edge = it1
+    if ckpt is not None:
+        if not report.preempted and train_cfg.iters != last_saved:
+            ckpt.save(train_cfg.iters, state, force=True, overwrite=True)
+        ckpt.close()
+    _flush_losses()
+    t_end = time.perf_counter()
+    # report.start_step, not the local: a slow-path recovery may have
+    # rewound the record's origin below the resumed-from step.
+    report.steps = (last_it + 1 if report.preempted else train_cfg.iters) \
+        - report.start_step
+    if t_start is not None and report.steps > excluded_steps:
+        report.wall_time = t_end - t_start
+        report.tokens_per_sec = timed_tokens / max(report.wall_time, 1e-9)
+    if report.remeshes and phase_t0 is not None and phase_tokens > 0:
+        report.post_remesh_tokens_per_sec = (
+            phase_tokens / max(t_end - phase_t0, 1e-9))
+    if telemetry is not None:
+        telemetry.registry.absorb_spans(spans)
+        telemetry.registry.absorb_resilience(report.resilience)
+        telemetry.events.run_end(
+            steps=report.steps, start_step=report.start_step,
+            preempted=report.preempted, remeshes=len(report.remeshes),
+            tokens_per_sec=report.tokens_per_sec, wall_s=report.wall_time,
+            post_remesh_tokens_per_sec=report.post_remesh_tokens_per_sec,
+            metrics=telemetry.registry.snapshot())
+        telemetry.heartbeat.beat(step=last_it + 1, phase="done")
+    return report
+
+
 def _apply_resilience(step_fn, resilience: Optional[ResilienceConfig],
-                      fault_plan, ckpt, stats: ResilienceStats):
+                      fault_plan, ckpt, stats: ResilienceStats, *,
+                      start: int = 0):
     """Compose the resilience layer around a trainer's step function:
     fault injection innermost (so the guard sees the faulted step — the two
     halves test each other), StepGuard outermost. ``fault_plan`` may come in
     as an object (tests) or via ``resilience.faults`` (CLI/config); fault
-    step indices are post-resume call indices."""
+    step indices are post-resume call indices. ``start`` offsets the fault
+    wrapper's dispatch counter — the elastic loop re-applies this to a step
+    function REBUILT mid-run, and already-delivered faults must not
+    re-fire (the StepGuard starts fresh either way: its EMA detector must
+    re-learn the new topology's update norms)."""
     if fault_plan is None and resilience is not None and resilience.faults:
         fault_plan = resilience.fault_plan()
     if fault_plan:
-        step_fn = fault_plan.wrap_step(step_fn)
+        step_fn = fault_plan.wrap_step(step_fn, start=start)
     if resilience is not None and resilience.guard:
         from ..resilience.guard import StepGuard
         step_fn = StepGuard(
@@ -553,6 +818,16 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     ``fault_plan`` (resilience.FaultPlan) injects deterministic faults for
     tests/chaos runs; counters come back in ``report.resilience``.
 
+    ``resilience.elastic=True`` (gradient/zero1 only) survives replica
+    loss: a ``device_loss`` fault (or any ``ReplicaLossError``) at
+    dispatch k drains the loop at the chunk edge, re-meshes onto the
+    surviving devices, reshards params + ZeRO-1 optimizer state to the
+    new world size (host-RAM mirror fast path / checkpoint slow path —
+    resilience/elastic.py), re-splits the stream and resumes; recovery
+    records land in ``report.remeshes`` and the telemetry ``remesh``
+    event. With zero faults the elastic loop's losses are bitwise the
+    non-elastic path's.
+
     ``telemetry`` (telemetry.Telemetry) opens the run's observability
     surface: a manifest event with the step's static comm profile, per-step
     records + heartbeat from the loop, fault deltas, and a run_end metrics
@@ -576,6 +851,34 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     spd = train_cfg.steps_per_dispatch
     if spd < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
+    elastic = bool(resilience is not None and resilience.elastic)
+    if elastic:
+        # Elastic DP (resilience/elastic.py): the loop drives the [K, B, T]
+        # window step (K = steps_per_dispatch, 1 included) so replica-loss
+        # drain/recovery quantizes to chunk edges. Gradient/zero1 only —
+        # the weight-aggregation and compressed-wire steps own collective
+        # schedules nobody has taught to re-mesh.
+        if aggregation not in ("gradient", "zero1"):
+            raise ValueError("elastic mode supports gradient and zero1 "
+                             f"aggregation only (got {aggregation!r})")
+        if train_cfg.wire != "fp32":
+            raise ValueError("elastic mode requires wire='fp32'")
+        if any(s > 1 for a, s in mesh.shape.items() if a != "data"):
+            raise ValueError("elastic mode supports data-axis-only meshes "
+                             f"(got {dict(mesh.shape)})")
+
+        def _build_elastic(m):
+            """(template_state, raw window step, window shard fn) on an
+            arbitrary data mesh — initial build AND post-loss rebuild go
+            through here, so the two cannot drift."""
+            if aggregation == "zero1":
+                st, fn = dp.make_zero1_multi_step(loss_fn, optimizer, m,
+                                                  params)
+            else:
+                fn = dp.make_multi_step(loss_fn, optimizer, m,
+                                        accum_steps=train_cfg.accum_steps)
+                st = dp.replicate(m, dp.init_state(params, optimizer))
+            return st, fn, (lambda w, m=m: dp.shard_batch_window(m, w))
     state = None
     if train_cfg.wire != "fp32":
         # Compressed gradient allreduce (parallel/compress.py) — gradient
@@ -605,14 +908,18 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             raise ValueError("accum_steps composes with gradient "
                              "aggregation only (zero1 scatters the raw "
                              "local gradient)")
-        if spd > 1:
+        if elastic:
+            state, step_fn, window_shard = _build_elastic(mesh)
+        elif spd > 1:
             state, step_fn = dp.make_zero1_multi_step(loss_fn, optimizer,
                                                       mesh, params)
         else:
             state, step_fn = dp.make_zero1_step(loss_fn, optimizer, mesh,
                                                 params)
     elif aggregation == "gradient":
-        if spd > 1:
+        if elastic:
+            state, step_fn, window_shard = _build_elastic(mesh)
+        elif spd > 1:
             step_fn = dp.make_multi_step(
                 loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
         else:
@@ -640,12 +947,42 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     _emit_manifest(telemetry, trainer="dp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
                    step_fn=step_fn, state=state, n_data=n_data,
-                   steps_per_dispatch=spd)
+                   steps_per_dispatch=spd, windowed=elastic)
+    if fault_plan is None and resilience is not None and resilience.faults:
+        fault_plan = resilience.fault_plan()   # resolve ONCE: the elastic
+        #   rebuild must re-wrap the same schedule, not a fresh counter's
+
+    def _make_batches(n):
+        # Disjoint stream windows per data shard — the reference's
+        # skip=rank*5000. Recovery re-splits at the new width through
+        # this same constructor, so the post-remesh data order is exactly
+        # a fresh n-replica run's.
+        return sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
+                               n, shard_skip=5000, seed=train_cfg.seed)
+
+    if elastic:
+        from ..resilience.elastic import ElasticController
+
+        def _rewrap(fn, start=0):
+            return _apply_resilience(fn, resilience, fault_plan, ckpt,
+                                     stats, start=start)
+
+        controller = ElasticController(
+            mesh, build=_build_elastic, rewrap=_rewrap,
+            make_batches=_make_batches, ckpt=ckpt,
+            mirror_every=resilience.mirror_every, stats=stats,
+            telemetry=telemetry, log_fn=log_fn)
+        return _run_elastic_loop(
+            controller, _rewrap(step_fn), state, _make_batches(n_data),
+            train_cfg, n_data=n_data, start_step=start_step, ckpt=ckpt,
+            checkpoint_every=checkpoint_every, loss_sink=loss_sink,
+            sink_every=sink_every, log_every=log_every, log_fn=log_fn,
+            warmup_steps_excluded=warmup_steps_excluded, stats=stats,
+            telemetry=telemetry, steps_per_dispatch=spd,
+            window_shard_fn=window_shard)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
-    # Disjoint stream windows per data shard — the reference's skip=rank*5000.
-    batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len, n_data,
-                              shard_skip=5000, seed=train_cfg.seed)
+    batches = _make_batches(n_data)
     return _run_loop(step_fn, state, batches, train_cfg,
                      lambda b: dp.shard_batch(mesh, b), n_data=n_data,
                      start_step=start_step, ckpt=ckpt,
@@ -702,6 +1039,10 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         raise ValueError("steps_per_dispatch (fused multi-step dispatch) is "
                          "DP-trainer-only; the pipeline step owns its own "
                          "schedule")
+    if resilience is not None and resilience.elastic:
+        raise ValueError("elastic mode is DP-trainer-only: losing a replica "
+                         "from a PP mesh orphans its stage partners — a "
+                         "re-wiring problem, not a resharding one")
     mesh = mesh or make_mesh({"data": train_cfg.data,
                               "stage": train_cfg.stage})
     n_data = mesh.shape.get("data", 1)

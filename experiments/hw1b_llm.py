@@ -37,16 +37,16 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                 checkpoint_dir: str = None, faults: str = "",
                 fault_seed: int = 0, guard: bool = False,
                 telemetry_dir: str = None, steps_per_dispatch: int = 1,
-                zero1: bool = False) -> Dict[str, float]:
+                zero1: bool = False, elastic: bool = False) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
-    if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1):
-        # Both hot-path levers are DP-trainer-only (the PP step owns its
+    if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1 or elastic):
+        # These levers are DP-trainer-only (the PP step owns its
         # own schedule/collectives); failing loudly beats silently timing
         # the wrong program.
-        raise ValueError(f"--steps-per-dispatch/--zero1 need a DP config "
-                         f"(got {name})")
+        raise ValueError(f"--steps-per-dispatch/--zero1/--elastic need a DP "
+                         f"config (got {name})")
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
                             **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
@@ -70,13 +70,15 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                   loss_sink=lambda it, loss: sink.write(
                       {"iter": it, "loss": loss, "data": provenance,
                        "config": label}))
-    if faults or guard:
-        # Chaos/guarded runs (resilience layer): inject the scheduled faults
-        # and/or wrap the step in a StepGuard; counters print at the end so
+    if faults or guard or elastic:
+        # Chaos/guarded/elastic runs (resilience layer): inject the
+        # scheduled faults, wrap the step in a StepGuard, and/or arm the
+        # elastic replica-loss recovery; counters print at the end so
         # the run's survival is attributable, not anecdotal.
         from ddl25spring_tpu.config import ResilienceConfig
         kw["resilience"] = ResilienceConfig(guard=guard, faults=faults,
-                                            fault_seed=fault_seed)
+                                            fault_seed=fault_seed,
+                                            elastic=elastic)
     telemetry = None
     if telemetry_dir is not None:
         # Unified observability (ddl25spring_tpu/telemetry): JSONL event
@@ -102,10 +104,14 @@ def _run_config(name: str, iters: int, sink, provenance: str,
         if telemetry is not None:
             telemetry.close()
             print(f"{name}: telemetry -> {telemetry.out_dir}", flush=True)
-    if report.resilience is not None and (faults or guard):
+    if report.resilience is not None and (faults or guard or elastic):
         print(f"{name}: resilience counters "
               f"{ {k: v for k, v in report.resilience.as_dict().items() if v} }",
               flush=True)
+    for rec in report.remeshes:
+        print(f"{name}: remesh {rec['old_world']} -> {rec['new_world']} "
+              f"via {rec['path']} in {rec['seconds']:.3f}s "
+              f"({rec['steps_replayed']} steps replayed)", flush=True)
     if not report.losses:
         return {}  # resumed past the end; nothing new to record
     # Resume offset (0 for a fresh run). NOT iters - len(losses): a
@@ -131,7 +137,7 @@ def main(quick: bool = False, iters: int = 5000,
          checkpoint_dir: str = None, faults: str = "",
          fault_seed: int = 0, guard: bool = False,
          telemetry_dir: str = None, steps_per_dispatch: int = 1,
-         zero1: bool = False) -> Dict[str, float]:
+         zero1: bool = False, elastic: bool = False) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -160,7 +166,7 @@ def main(quick: bool = False, iters: int = 5000,
                                fault_seed=fault_seed, guard=guard,
                                telemetry_dir=telemetry_dir,
                                steps_per_dispatch=steps_per_dispatch,
-                               zero1=zero1))
+                               zero1=zero1, elastic=elastic))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -209,6 +215,12 @@ if __name__ == "__main__":
                          "reduce-scatter grads, Adam on each replica's 1/N "
                          "slice, all-gather params; DP configs only — "
                          "composes with --steps-per-dispatch)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic DP (resilience/elastic.py): survive "
+                         "replica loss (inject with --faults "
+                         "'device_loss@K') by re-meshing onto the "
+                         "survivors and resharding params + ZeRO-1 state; "
+                         "DP configs only")
     a = ap.parse_args()
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual
@@ -222,4 +234,5 @@ if __name__ == "__main__":
          checkpoint_dir=a.checkpoint_dir, faults=a.faults,
          fault_seed=a.fault_seed, guard=a.guard,
          telemetry_dir=a.telemetry_dir,
-         steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1)
+         steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1,
+         elastic=a.elastic)

@@ -60,6 +60,7 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
     steps = by_type.get("step", [])
     faults = by_type.get("fault", [])
     rounds = by_type.get("fl_round", [])
+    remeshes = by_type.get("remesh", [])
 
     _section("run")
     print(f"run_id: {events[0].get('run_id')}   events: {len(events)}")
@@ -99,6 +100,20 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
             print("step time: " + "  ".join(
                 f"p{q:g}={percentile(dts, q) * 1e3:.1f}ms"
                 for q in (50, 95, 99)) + f"  n={len(dts)} windows")
+
+    if remeshes:
+        _section("remesh (elastic recoveries)")
+        for e in remeshes:
+            lost = e.get("lost")
+            print(f"  step {e.get('it', '?'):>6}: "
+                  f"{e.get('old_world', '?')} -> {e.get('new_world', '?')} "
+                  f"replicas"
+                  + (f" (lost {lost})" if lost else "")
+                  + f"  via {e.get('path', '?')}"
+                  + (f"  {e['seconds']:.3f}s lost"
+                     if isinstance(e.get("seconds"), (int, float)) else "")
+                  + (f"  {e['steps_replayed']} steps replayed"
+                     if e.get("steps_replayed") is not None else ""))
 
     if rounds:
         _section("fl rounds")
@@ -147,7 +162,8 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
 
     if run_end:
         _section("run end")
-        for k in ("steps", "preempted", "tokens_per_sec", "wall_s",
+        for k in ("steps", "preempted", "remeshes", "tokens_per_sec",
+                  "post_remesh_tokens_per_sec", "wall_s",
                   "final_accuracy"):
             if run_end.get(k) is not None:
                 print(f"{k}: {run_end[k]}")

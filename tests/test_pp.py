@@ -229,3 +229,31 @@ def test_interleaved_matches_single_device_s4(devices):
     got = pp.deinterleave_params(got, 4, 2)
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_trees_close(got, jax.device_get(ref_params), 2e-5)
+
+
+def test_pp_chaos_nan_grad_at_dispatch_guarded_run_completes(devices):
+    """Chaos coverage for the PP path (mirroring the DP dispatch-
+    granularity skip test, tests/test_dp.py): a ``nan_grad`` fault at
+    dispatch 2, injected around ``make_pipeline_step``'s guarded wrapper
+    through the full PP trainer, is skipped by the StepGuard — the NaN is
+    visible in the loss record at exactly its step, counted as one
+    consumed-not-learned step, and training continues finite afterwards."""
+    from ddl25spring_tpu.config import ResilienceConfig, TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    report = train_llm_pp(
+        cfg,
+        TrainConfig(batch_size=2, seq_len=16, iters=6, lr=3e-3, stage=2,
+                    microbatches=2),
+        mesh=make_mesh({"data": 1, "stage": 2}, devices=devices[:2]),
+        tokenizer=ByteTokenizer(), log_every=0,
+        resilience=ResilienceConfig(guard=True, faults="nan_grad@2"))
+    assert report.resilience.skipped_steps == 1
+    assert report.resilience.rollbacks == 0
+    assert len(report.losses) == 6
+    assert not np.isfinite(report.losses[2])      # the fault is visible...
+    assert np.isfinite([l for i, l in enumerate(report.losses)
+                        if i != 2]).all()         # ...and contained
