@@ -461,6 +461,44 @@ def main():
             print(f"decode batch {dec_bs}{label}: failed ({e})",
                   file=sys.stderr)
 
+    # Serving row (ddl25spring_tpu/serving): continuous batching over the
+    # paged KV pool under seeded Poisson traffic — the AGGREGATE number the
+    # static-batch decode rows above cannot give: sustained tok/s and p99
+    # TTFT at N concurrent mixed-length streams sharing one block pool.
+    # Same isolation contract as the decode sidebar (stderr, never sinks
+    # the bench); reduced model on the CPU fallback, canonical on a chip.
+    try:
+        from ddl25spring_tpu.models import llama as _llama
+        from ddl25spring_tpu.serving import (PagedKVConfig, run_serving,
+                                             synthetic_workload)
+        if PLATFORM in (None, "cpu"):
+            scfg = dataclasses.replace(
+                base, vocab_size=512, dmodel=64, num_heads=2, n_layers=2,
+                ctx_size=64, attention_impl="xla", dtype="float32")
+            n_req = 20 if QUICK else 60
+        else:
+            scfg = base
+            n_req = 40 if QUICK else 200
+        n_slots = 8
+        sparams = _llama.init_llama(jax.random.key(0), scfg)
+        paged = PagedKVConfig(num_blocks=33, block_len=8,
+                              max_blocks_per_seq=8)
+        wl = synthetic_workload(seed=0, n_requests=n_req, rate_rps=50.0,
+                                vocab_size=scfg.vocab_size,
+                                prompt_lens=(4, 12, 24),
+                                max_news=(4, 8, 16))
+        rep = run_serving(sparams, scfg, paged, wl, num_slots=n_slots,
+                          prefill_chunk=8, token_events=False)
+        agg = rep.aggregates
+        print(f"serving {n_slots:2d} streams x {n_req} reqs: "
+              f"{agg['sustained_tokens_per_sec']:10.0f} tok/s sustained  "
+              f"p99 TTFT {agg['ttft_s']['p99'] * 1e3:7.1f} ms  "
+              f"peak blocks {rep.peak_blocks_in_use}/{rep.pool_blocks}",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"serving bench: failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] == "--one":

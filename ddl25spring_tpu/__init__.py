@@ -23,6 +23,11 @@ Subpackages:
   parallel  — DP / PP / TP / SP strategies and the FL client/server suite
   resilience— fault injection (FaultPlan) + self-healing (StepGuard, retry,
               preemption handling) for every training path
+  serving   — production inference: paged KV cache + continuous-batching
+              scheduler + Poisson load front end (bitwise-parity with
+              models.generate)
+  telemetry — schema-versioned JSONL event stream, comm accounting,
+              heartbeat liveness, metrics registry
   utils     — pytree helpers, timing, checkpointing, logging
 """
 

@@ -149,14 +149,16 @@ def forward_cached(params: dict, tokens: jnp.ndarray, cache: dict,
                           cache, start, cfg)
 
 
-def _sample(key, logits: jnp.ndarray, temperature: float,
-            top_k: Optional[int], top_p: Optional[float]) -> jnp.ndarray:
-    """logits [B, V] → token ids [B]. temperature 0 = greedy (argmax).
-    top_k and top_p (nucleus) filters compose: k-truncation first, then the
-    smallest prefix of the remaining distribution whose mass reaches p."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+def filter_logits(logits: jnp.ndarray, top_k: Optional[int],
+                  top_p: Optional[float]) -> jnp.ndarray:
+    """Apply the top_k / top_p (nucleus) filters to temperature-scaled
+    logits [B, V]. The filters compose: k-truncation first, then the
+    smallest prefix of the remaining distribution whose mass reaches p.
+
+    The ONE implementation of the filter contract: the serving engine's
+    per-slot sampler (serving/engine.py) calls this too, and its
+    bitwise-parity bar means the two paths must stay the same ops — keep
+    any change here."""
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]    # [B, 1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -173,6 +175,15 @@ def _sample(key, logits: jnp.ndarray, temperature: float,
         thresh = jnp.min(jnp.where(kept, sorted_logits, jnp.inf),
                          axis=-1, keepdims=True)               # [B, 1]
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
+def _sample(key, logits: jnp.ndarray, temperature: float,
+            top_k: Optional[int], top_p: Optional[float]) -> jnp.ndarray:
+    """logits [B, V] → token ids [B]. temperature 0 = greedy (argmax)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = filter_logits(logits / temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1)
 
 
@@ -192,12 +203,24 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     then). ``kv_dtype`` narrows the cache storage dtype (init_cache).
     """
     b, tp = prompt.shape
-    assert max_new_tokens >= 1, max_new_tokens
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     assert top_p is None or 0.0 < top_p <= 1.0, \
         f"top_p must be in (0, 1], got {top_p}"  # p<=0 would mask every token
     if max_len is None:
         max_len = tp + max_new_tokens
-    assert max_len >= tp + max_new_tokens, (max_len, tp, max_new_tokens)
+    if max_len < tp + max_new_tokens:
+        # Hard error, not an assert: an oversized request would silently
+        # write K/V past the masked range (dynamic_update_slice clamps the
+        # start index, so late positions OVERWRITE earlier cache entries)
+        # and the tail tokens would be garbage — and `python -O` would
+        # strip an assert entirely. Raised at trace time, so it fires on
+        # the first call of each shape, jit or not.
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {tp} + {max_new_tokens} = "
+            f"{tp + max_new_tokens} exceeds max_len={max_len}: the KV cache "
+            f"only holds max_len positions, so the request cannot fit — "
+            f"raise max_len or shorten the request")
     if key is None:
         assert temperature == 0.0, "sampling (temperature>0) requires a key"
         key = jax.random.PRNGKey(0)   # unused by greedy argmax

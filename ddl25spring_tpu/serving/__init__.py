@@ -1,0 +1,29 @@
+"""Production serving layer: continuous batching over a paged KV cache.
+
+Four layers (ISSUE 6 / ROADMAP item 2), bottom-up:
+
+- kvcache   — fixed-size device block pool + host free-list allocator;
+              sequences of different lengths share one pool through
+              per-slot block tables instead of each owning a ``max_len``
+              cache (vLLM-style paging, static-shape/one-compile).
+- engine    — ``prefill_chunk`` / ``decode_step`` compiled ONCE over a
+              fixed slot axis; chunked prefill interleaves with in-flight
+              decode; bitwise-parity with ``models.generate`` pinned in
+              tests.
+- scheduler — Orca-style iteration-level (continuous) batching: FCFS
+              admission with worst-case block reservation (never
+              deadlocks), retirement frees blocks at the next token
+              boundary; ``request_*`` telemetry events.
+- frontend  — seeded Poisson load generator (mixed prompt/output length
+              mixtures) + ``run_serving`` driver and the latency
+              aggregation behind bench.py's serving row and
+              ``experiments/obs_report.py``'s serving section.
+"""
+
+from .engine import Engine, TokenEvent  # noqa: F401
+from .frontend import (ServingReport, aggregate_latency,  # noqa: F401
+                       reference_stream, run_serving, synthetic_workload)
+from .kvcache import (TRASH_BLOCK, BlockAllocator,  # noqa: F401
+                      PagedKVConfig, blocks_for, init_pool,
+                      kv_bytes_per_token, naive_cache_bytes, pool_bytes)
+from .scheduler import Request, RequestRecord, Scheduler  # noqa: F401

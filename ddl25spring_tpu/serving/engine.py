@@ -1,0 +1,449 @@
+"""Step-level serving engine: prefill()/decode_step() over a fixed slot axis.
+
+`models/generate.py` fuses prefill + the whole decode horizon into one
+compiled scan — perfect for a bench, useless for a server, where the batch
+composition changes at every token boundary. This engine refactors the same
+math into TWO reusable compiled programs over a fixed slot axis ``[S]``:
+
+- ``prefill_chunk``: one slot's prompt chunk ``[1, Tc]`` through the model,
+  writing K/V into the slot's pool blocks; the FINAL chunk also samples the
+  first token (TTFT). Chunking lets a long prompt interleave with in-flight
+  decode instead of stalling it — the scheduler advances one chunk per
+  token boundary.
+- ``decode_step``: one token for ALL slots ``[S]`` at once — per-slot
+  position, RNG key, temperature and active-mask ride in the slot state, so
+  admissions/retirements between steps never recompile anything.
+
+Both are compiled exactly once per engine (static shapes; the pool is
+donated so XLA updates blocks in place), and both are built from the same
+building blocks as ``generate`` — ``_fuse_blocks``, ``llama.embed/head``,
+the fp32-softmax attention layout of ``_attend_cached`` — deliberately
+op-for-op, because the acceptance bar is BITWISE: a request decoded here,
+at any slot, in any company, must emit exactly the tokens ``generate()``
+emits for it alone (tests/test_generate.py, tests/test_serving.py).
+
+The bitwise-parity constraints that shaped the code:
+- Every op is row-independent (norms, matmuls, softmax-per-row, per-slot
+  RNG), so batch company cannot leak between slots.
+- The gathered cache is padded to ``paged.max_seq_len`` and masked by
+  absolute position; masked garbage contributes exact zeros through
+  softmax (``exp(-inf) = 0``), same as ``generate``'s unwritten tail —
+  parity tests run ``generate(max_len=paged.max_seq_len)`` so both sides
+  reduce over identically-shaped score rows.
+- Per-slot sampling keeps ``generate``'s exact RNG discipline: split the
+  slot key every step, sample from the sub-key — so equal seeds give equal
+  streams. Temperature is a traced per-slot scalar (greedy selected by a
+  ``where``, both branches computed); top_k/top_p stay engine-static, the
+  same filters ``_sample`` applies.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import LlamaConfig
+from .. import nn
+from ..models import generate, llama
+from .kvcache import (TRASH_BLOCK, BlockAllocator, PagedKVConfig, blocks_for,
+                      init_pool)
+
+
+# ------------------------------------------------------------- paged forward
+
+def _attend_paged(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
+                  q_positions: jnp.ndarray) -> jnp.ndarray:
+    """``generate._attend_cached`` with a PER-SLOT position mask: q
+    [S, Tq, H, Dh] over the gathered cache [S, Tmax, H, Dh], masked to
+    ``kpos <= q_position`` per (slot, query-row). Identical layout and op
+    sequence (fp32 softmax, heads folded into batch) so per-row numerics
+    match the contiguous-cache path bitwise."""
+    b, tq, h, dh = q.shape
+    tmax = ck.shape[1]
+    scale = 1.0 / math.sqrt(dh)
+    qm = q.transpose(0, 2, 1, 3).reshape(b * h, tq, dh)
+    km = ck.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh).astype(q.dtype)
+    vm = cv.transpose(0, 2, 1, 3).reshape(b * h, tmax, dh).astype(q.dtype)
+    scores = lax.dot_general(qm, km, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32) * scale
+    qpos = jnp.broadcast_to(q_positions[:, None, :], (b, h, tq))
+    mask = qpos.reshape(b * h, tq)[:, :, None] >= jnp.arange(tmax)[None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = lax.dot_general(probs, vm, (((2,), (1,)), ((0,), (0,))))
+    return out.reshape(b, h, tq, dh).transpose(0, 2, 1, 3)
+
+
+def _apply_rope_slots(x: jnp.ndarray, cos: jnp.ndarray,
+                      sin: jnp.ndarray) -> jnp.ndarray:
+    """``llama.apply_rope`` with per-slot tables: cos/sin [S, T, half]
+    instead of the shared [T, half] (slots sit at different absolute
+    positions). Same rotation arithmetic, elementwise."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
+def _block_paged(block: dict, pk: jnp.ndarray, pv: jnp.ndarray,
+                 x: jnp.ndarray, positions: jnp.ndarray,
+                 tables: jnp.ndarray, wblk: jnp.ndarray, woff: jnp.ndarray,
+                 cfg: LlamaConfig):
+    """One pre-fused block over x [S, T, D] at per-slot absolute
+    ``positions`` [S, T], writing this call's K/V into pool blocks at
+    (``wblk``, ``woff``) [S, T] and attending over each slot's gathered
+    block table. The paged twin of ``generate._block_with_cache``; the
+    scatter/gather replaces its dynamic_update_slice/full-cache read, the
+    math around them is identical."""
+    s, t, d = x.shape
+    dh = cfg.head_dim
+    xn = nn.rmsnorm(block["attn_norm"], x, eps=cfg.norm_eps)
+    qkv = xn @ block["w_qkv"].astype(x.dtype)
+    dl = qkv.shape[-1] // 3
+    h_local = dl // dh
+    q = qkv[..., :dl].reshape(s, t, h_local, dh)
+    k = qkv[..., dl:2 * dl].reshape(s, t, h_local, dh)
+    v = qkv[..., 2 * dl:].reshape(s, t, h_local, dh)
+    cos, sin = llama.rope_angles(positions.reshape(-1), dh, cfg.rope_theta)
+    cos = cos.reshape(s, t, -1)
+    sin = sin.reshape(s, t, -1)
+    q = _apply_rope_slots(q, cos, sin)
+    k = _apply_rope_slots(k, cos, sin)       # cached K is stored post-RoPE
+    # Per-token scatter into the block pool. Distinct (block, offset)
+    # targets are guaranteed by block ownership; only TRASH_BLOCK collides
+    # (inactive slots, padded tails) and its contents are never read
+    # un-masked.
+    pk = pk.at[wblk, woff].set(k.astype(pk.dtype))
+    pv = pv.at[wblk, woff].set(v.astype(pv.dtype))
+    ck = pk[tables].reshape(s, -1, h_local, dh)    # [S, Tmax, H, Dh]
+    cv = pv[tables].reshape(s, -1, h_local, dh)
+    out = _attend_paged(q, ck, cv, positions)
+    x = x + out.reshape(s, t, h_local * dh) @ block["wo"].astype(x.dtype)
+    xn = nn.rmsnorm(block["mlp_norm"], x, eps=cfg.norm_eps)
+    gu = xn @ block["w_gu"].astype(x.dtype)
+    f = gu.shape[-1] // 2
+    x = x + (jax.nn.silu(gu[..., :f]) * gu[..., f:]) @ block["w_down"].astype(x.dtype)
+    return x, pk, pv
+
+
+def _forward_paged(params: dict, fused_blocks: dict, tokens: jnp.ndarray,
+                   pool: dict, tables: jnp.ndarray, positions: jnp.ndarray,
+                   wblk: jnp.ndarray, woff: jnp.ndarray, cfg: LlamaConfig):
+    """tokens [S, T] at per-slot absolute ``positions`` [S, T] → (hidden
+    [S, T, D], updated pool). One lax.scan over the stacked layers,
+    threading each layer's block-pool slice — the paged twin of
+    ``generate._forward_fused`` (which threads cache slices)."""
+    h = llama.embed(params, tokens, cfg)
+
+    def body(carry, layer):
+        block, pk, pv = layer
+        out, pk, pv = _block_paged(block, pk, pv, carry, positions,
+                                   tables, wblk, woff, cfg)
+        return out, (pk, pv)
+
+    h, (pk, pv) = lax.scan(body, h, (fused_blocks, pool["k"], pool["v"]))
+    return h, {"k": pk, "v": pv}
+
+
+def _sample_slot(key, logits: jnp.ndarray, temperature: jnp.ndarray,
+                 top_k: Optional[int], top_p: Optional[float]) -> jnp.ndarray:
+    """``generate._sample`` with a TRACED per-slot temperature: logits
+    [1, V] → token [1]. Greedy (t == 0) is a ``where``-select over both
+    branches instead of Python control flow, so one compile serves any
+    per-slot mix; the sampled branch applies the SAME ``filter_logits``
+    and ``categorical`` ops as ``generate`` (one filter implementation —
+    the bitwise-parity bar depends on it)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = generate.filter_logits(logits / safe_t, top_k, top_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+# ------------------------------------------------------------ compiled steps
+
+def make_prefill_chunk(cfg: LlamaConfig, paged: PagedKVConfig,
+                       chunk_len: int, top_k: Optional[int],
+                       top_p: Optional[float]):
+    """One compiled program: one slot's prompt chunk [chunk_len] through the
+    model, K/V scattered into the slot's blocks. Also computes the
+    next-token sample from the chunk's last VALID row — the host uses it
+    only for the final chunk (``generate`` splits its key exactly once
+    after prefill, so intermediate chunks must not consume randomness:
+    the caller passes the key only when ``is_final``)."""
+    bl, mb = paged.block_len, paged.max_blocks_per_seq
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def prefill_chunk(pool: dict, params: dict, fused: dict,
+                      table_row: jnp.ndarray, tokens: jnp.ndarray,
+                      start: jnp.ndarray, n_valid: jnp.ndarray,
+                      key: jnp.ndarray, temperature: jnp.ndarray):
+        start = jnp.asarray(start, jnp.int32)
+        pos = start + jnp.arange(chunk_len, dtype=jnp.int32)       # [Tc]
+        valid = jnp.arange(chunk_len) < n_valid
+        blk_idx = jnp.minimum(pos // bl, mb - 1)
+        wblk = jnp.where(valid, table_row[blk_idx], TRASH_BLOCK)
+        woff = pos % bl
+        h, pool = _forward_paged(params, fused, tokens[None], pool,
+                                 table_row[None], pos[None],
+                                 wblk[None], woff[None], cfg)
+        # Logits of the last valid row only — the [1, 1, D] head matmul
+        # ``generate`` performs (never the full [Tc, V] logits).
+        last = jnp.take_along_axis(
+            h, (n_valid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
+        logits = llama.head(params, last, cfg)[:, 0, :]            # [1, V]
+        key, sub = jax.random.split(key)
+        tok = _sample_slot(sub, logits, temperature, top_k, top_p)
+        return pool, tok[0], key
+
+    return prefill_chunk
+
+
+def make_decode_step(cfg: LlamaConfig, paged: PagedKVConfig,
+                     num_slots: int, top_k: Optional[int],
+                     top_p: Optional[float]):
+    """One compiled program: one token for ALL ``num_slots`` slots. Each
+    slot feeds back its last token at its own position, writes K/V into its
+    own blocks (inactive slots write to trash), and samples with its own
+    key/temperature. Admission, retirement and raggedness are pure data —
+    the program never recompiles."""
+    bl, mb = paged.block_len, paged.max_blocks_per_seq
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def decode_step(pool: dict, params: dict, fused: dict,
+                    tables: jnp.ndarray, last_tok: jnp.ndarray,
+                    pos: jnp.ndarray, keys: jnp.ndarray,
+                    temps: jnp.ndarray, active: jnp.ndarray):
+        blk_idx = jnp.minimum(pos // bl, mb - 1)
+        own = jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0]
+        wblk = jnp.where(active, own, TRASH_BLOCK)
+        woff = pos % bl
+        h, pool = _forward_paged(params, fused, last_tok[:, None], pool,
+                                 tables, pos[:, None],
+                                 wblk[:, None], woff[:, None], cfg)
+        logits = llama.head(params, h, cfg)[:, 0, :]               # [S, V]
+        split = jax.vmap(jax.random.split)(keys)                   # [S, 2, 2]
+        subs = split[:, 1]
+        # Only ACTIVE slots consume randomness: a slot still mid-prefill
+        # (or free) must keep its key untouched, or its stream would start
+        # shifted relative to ``generate``'s by however many decode steps
+        # happened to run before its admission finished.
+        new_keys = jnp.where(active[:, None], split[:, 0], keys)
+        toks = jax.vmap(
+            lambda k, l, t: _sample_slot(k, l[None], t, top_k, top_p)[0]
+        )(subs, logits, temps)
+        return pool, toks, new_keys
+
+    return decode_step
+
+
+# ----------------------------------------------------------------- the engine
+
+class TokenEvent(NamedTuple):
+    """One emitted token: ``first`` marks the TTFT token (sampled by the
+    final prefill chunk), ``done`` that the slot retired with this token."""
+    slot: int
+    token: int
+    first: bool
+    done: bool
+
+
+class _Slot:
+    __slots__ = ("blocks", "prompt", "max_new", "produced", "prefill_off",
+                 "phase", "seq")
+
+    def __init__(self, blocks, prompt, max_new, seq):
+        self.blocks = blocks          # owned pool block indices
+        self.prompt = prompt          # np.int32 [Tp]
+        self.max_new = max_new
+        self.produced = 0
+        self.prefill_off = 0          # tokens of prompt already prefilled
+        self.phase = "prefill"        # "prefill" -> "decode"
+        self.seq = seq                # admission order (prefill is FCFS by
+                                      # THIS, not by slot index — a freed
+                                      # low slot must not jump the line)
+
+
+class Engine:
+    """Slots + compiled steps + block plumbing. Queueing, time and
+    telemetry live one layer up (scheduler.py); this class only knows how
+    to admit a request into a free slot, advance prefill by one chunk,
+    decode one token for everyone, and retire finished slots (freeing
+    their blocks immediately).
+
+    ``step()`` is one token boundary: at most one prefill chunk (FCFS over
+    mid-prefill slots — the chunked-prefill interleave), then one decode
+    step if any slot is decoding. Returns the ``TokenEvent``s produced.
+    """
+
+    def __init__(self, params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
+                 num_slots: int, *, prefill_chunk: int = 16,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None):
+        if num_slots < 1 or prefill_chunk < 1:
+            raise ValueError(f"num_slots={num_slots}, "
+                             f"prefill_chunk={prefill_chunk}")
+        self.cfg = cfg
+        self.paged = paged
+        self.num_slots = num_slots
+        self.prefill_chunk_len = prefill_chunk
+        self.params = params
+        self.fused = generate._fuse_blocks(params["blocks"])  # hoisted once
+        self.pool = init_pool(cfg, paged)
+        self.allocator = BlockAllocator(paged.num_blocks)
+        self._admit_seq = 0
+        self.slots: List[Optional[_Slot]] = [None] * num_slots
+        # Host-side slot state, shipped to the device each step as COPIES
+        # (jnp.array, never jnp.asarray: a zero-copy handoff would freeze
+        # these buffers read-only under the host's feet on the CPU
+        # backend). Tiny [S] rows; only the pool is device-resident and
+        # donated. Keys live device-side: decode returns the split batch.
+        self.tables = np.full((num_slots, paged.max_blocks_per_seq),
+                              TRASH_BLOCK, np.int32)
+        self.pos = np.zeros(num_slots, np.int32)
+        self.last_tok = np.zeros(num_slots, np.int32)
+        self.temps = np.zeros(num_slots, np.float32)
+        self.keys = jnp.zeros((num_slots, 2), jnp.uint32)
+        self._prefill = make_prefill_chunk(cfg, paged, prefill_chunk,
+                                           top_k, top_p)
+        self._decode = make_decode_step(cfg, paged, num_slots, top_k, top_p)
+
+    # ------------------------------------------------------------- admission
+    def required_blocks(self, prompt_len: int, max_new: int) -> int:
+        """Positions written are ``0..prompt_len+max_new-2`` (the final
+        sampled token is never fed back — ``generate``'s horizon)."""
+        return blocks_for(prompt_len + max_new - 1, self.paged.block_len)
+
+    def free_slot(self) -> Optional[int]:
+        for s, slot in enumerate(self.slots):
+            if slot is None:
+                return s
+        return None
+
+    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        return (self.free_slot() is not None
+                and self.required_blocks(prompt_len, max_new)
+                <= self.allocator.free_blocks)
+
+    def admit(self, prompt, max_new: int, *, temperature: float = 0.0,
+              key: Optional[jax.Array] = None) -> int:
+        """Place a request into a free slot and reserve its WORST-CASE
+        blocks up front. All-or-nothing reservation is the liveness
+        guarantee: an admitted request can always run to completion, so
+        pool exhaustion can only ever queue admissions, never deadlock
+        in-flight work (scheduler.py holds the policy argument)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tp, mx = len(prompt), int(max_new)
+        if tp < 1 or mx < 1:
+            raise ValueError(f"empty request: prompt_len={tp}, max_new={mx}")
+        if tp + mx - 1 > self.paged.max_seq_len:
+            raise ValueError(
+                f"request needs {tp + mx - 1} cache positions but the pool "
+                f"serves at most max_blocks_per_seq * block_len = "
+                f"{self.paged.max_seq_len}")
+        s = self.free_slot()
+        if s is None:
+            raise RuntimeError("no free slot")
+        blocks = self.allocator.alloc(self.required_blocks(tp, mx))
+        if blocks is None:
+            raise RuntimeError("pool exhausted")
+        self._admit_seq += 1
+        self.slots[s] = _Slot(blocks, prompt, mx, self._admit_seq)
+        self.tables[s] = TRASH_BLOCK
+        self.tables[s, :len(blocks)] = blocks
+        self.pos[s] = 0
+        self.temps[s] = float(temperature)
+        if key is None:
+            if temperature > 0:
+                raise ValueError("sampling (temperature>0) requires a key")
+            key = jax.random.PRNGKey(0)      # unused by greedy (generate's
+        self.keys = self.keys.at[s].set(key)  # own placeholder convention)
+        return s
+
+    # ----------------------------------------------------------- one boundary
+    @property
+    def busy(self) -> bool:
+        return any(slot is not None for slot in self.slots)
+
+    def blocks_in_use(self) -> int:
+        return self.allocator.in_use
+
+    def step(self) -> List[TokenEvent]:
+        """One token boundary: one prefill chunk (if a slot is mid-prefill),
+        then one decode step over the decoding slots."""
+        events: List[TokenEvent] = []
+        prefilling = [(sl.seq, i) for i, sl in enumerate(self.slots)
+                      if sl is not None and sl.phase == "prefill"]
+        if prefilling:
+            events.extend(self._advance_prefill(min(prefilling)[1]))
+        if any(sl is not None and sl.phase == "decode" for sl in self.slots):
+            events.extend(self._advance_decode())
+        return events
+
+    def _advance_prefill(self, s: int) -> List[TokenEvent]:
+        slot = self.slots[s]
+        tc = self.prefill_chunk_len
+        off = slot.prefill_off
+        n_valid = min(tc, len(slot.prompt) - off)
+        chunk = np.zeros(tc, np.int32)
+        chunk[:n_valid] = slot.prompt[off:off + n_valid]
+        is_final = off + n_valid >= len(slot.prompt)
+        self.pool, tok, new_key = self._prefill(
+            self.pool, self.params, self.fused,
+            jnp.array(self.tables[s]), jnp.array(chunk),
+            jnp.int32(off), jnp.int32(n_valid),
+            self.keys[s], jnp.float32(self.temps[s]))
+        slot.prefill_off = off + n_valid
+        if not is_final:
+            # Intermediate chunk: K/V written; the sampled token and split
+            # key are discarded so the slot's RNG stream stays exactly
+            # generate's (one split for the whole prefill).
+            return []
+        self.keys = self.keys.at[s].set(new_key)
+        first = int(tok)
+        slot.phase = "decode"
+        slot.produced = 1
+        self.pos[s] = len(slot.prompt)
+        self.last_tok[s] = first
+        done = slot.produced >= slot.max_new
+        if done:
+            self._retire(s)
+        return [TokenEvent(s, first, first=True, done=done)]
+
+    def _advance_decode(self) -> List[TokenEvent]:
+        active = np.array([sl is not None and sl.phase == "decode"
+                           for sl in self.slots])
+        self.pool, toks, new_keys = self._decode(
+            self.pool, self.params, self.fused,
+            jnp.array(self.tables), jnp.array(self.last_tok),
+            jnp.array(self.pos), self.keys,
+            jnp.array(self.temps), jnp.array(active))
+        toks = np.asarray(toks)
+        self.keys = new_keys
+        events = []
+        for s in np.nonzero(active)[0]:
+            slot = self.slots[s]
+            tok = int(toks[s])
+            slot.produced += 1
+            self.pos[s] += 1
+            self.last_tok[s] = tok
+            done = slot.produced >= slot.max_new
+            if done:
+                self._retire(s)
+            events.append(TokenEvent(int(s), tok, first=False, done=done))
+        return events
+
+    def _retire(self, s: int) -> None:
+        """Free the slot and its blocks IMMEDIATELY (the continuous-batching
+        point: the next token boundary can re-use them)."""
+        self.allocator.free(self.slots[s].blocks)
+        self.slots[s] = None
+        self.tables[s] = TRASH_BLOCK
+        self.pos[s] = 0
+        self.temps[s] = 0.0

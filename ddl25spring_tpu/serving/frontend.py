@@ -1,0 +1,211 @@
+"""Front end: synthetic heavy-traffic workloads + the serving run driver.
+
+The load half of the serving subsystem: a seeded Poisson arrival process
+over mixed prompt/output length distributions (`synthetic_workload` — the
+"millions of users" stand-in the north star asks to be measured against),
+and `run_serving`, the driver that replays such a workload through the
+continuous-batching scheduler in (fast-forwarded) real time and aggregates
+per-request latency into the serving headline: sustained tok/s + p50/p95/
+p99 queue wait and TTFT at N concurrent streams.
+
+Determinism contract: the workload is fully determined by its seed (one
+`np.random.default_rng` drives arrivals, lengths, temperatures, prompt
+tokens and per-request sampling seeds), and request CONTENT determines
+request TOKENS (scheduler.py's admission-order invariant) — so latency
+numbers are load-dependent but every token stream is reproducible and
+checkable against `generate()` one request at a time
+(experiments/serving_bench.py does exactly that).
+
+The clock is wall time with idle fast-forward: while requests are in
+flight the engine does real work and latencies are honest measurements;
+when the engine and queue are BOTH empty, the clock jumps to the next
+arrival instead of sleeping, so a light workload doesn't stretch CI
+wall time. Fast-forward never runs while anything is queued or in flight,
+so it cannot shrink a queue wait or a TTFT.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import LlamaConfig
+from ..telemetry.events import EventLog
+from ..telemetry.registry import percentile
+from .engine import Engine
+from .kvcache import PagedKVConfig, naive_cache_bytes, pool_bytes
+from .scheduler import Request, RequestRecord, Scheduler
+
+
+def synthetic_workload(*, seed: int, n_requests: int, rate_rps: float,
+                       vocab_size: int,
+                       prompt_lens: Sequence[int] = (8, 16, 48),
+                       prompt_weights: Optional[Sequence[float]] = None,
+                       max_news: Sequence[int] = (8, 16, 32),
+                       max_new_weights: Optional[Sequence[float]] = None,
+                       temperatures: Sequence[float] = (0.0, 0.8),
+                       temperature_weights: Optional[Sequence[float]] = None,
+                       ) -> List[Request]:
+    """Seeded Poisson arrivals (exponential inter-arrival at ``rate_rps``)
+    over mixed prompt/output length and temperature mixtures.
+
+    Lengths draw from small DISCRETE sets rather than continuous
+    distributions on purpose: the paged engine is shape-oblivious, but the
+    per-request `generate()` parity reference compiles once per distinct
+    (prompt_len, max_new, temperature) combination — a discrete mixture
+    keeps the verification sweep to a handful of compiles while still
+    exercising raggedness. Widen the sets (or pass weights) to skew the
+    mix; the engine itself never recompiles."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    reqs: List[Request] = []
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_rps))
+        tp = int(rng.choice(np.asarray(prompt_lens), p=prompt_weights))
+        mx = int(rng.choice(np.asarray(max_news), p=max_new_weights))
+        temp = float(rng.choice(np.asarray(temperatures, np.float64),
+                                p=temperature_weights))
+        prompt = tuple(int(x) for x in rng.integers(0, vocab_size, tp))
+        reqs.append(Request(rid=f"req-{i:04d}", prompt=prompt, max_new=mx,
+                            temperature=temp,
+                            seed=int(rng.integers(0, 2 ** 31 - 1)),
+                            arrival=t))
+    return reqs
+
+
+def reference_stream(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
+                     req: Request, *, top_k: Optional[int] = None,
+                     top_p: Optional[float] = None) -> List[int]:
+    """The bitwise-parity reference: ``generate()`` run ALONE on one
+    request. One implementation for every consumer of the parity bar
+    (tests + serving_bench), because the construction rules are load-
+    bearing and easy to get silently wrong: ``max_len`` must pin to
+    ``paged.max_seq_len`` (so both sides reduce over identically-shaped
+    score rows), ``kv_dtype`` must match the pool's storage dtype, and
+    key/temperature are passed only for sampling requests (greedy
+    ``generate`` forbids a key-less temperature, and its greedy path
+    ignores the key exactly like the engine's where-select)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import generate
+
+    kw = dict(max_len=paged.max_seq_len, kv_dtype=paged.kv_dtype,
+              top_k=top_k, top_p=top_p)
+    if req.temperature > 0:
+        kw.update(key=jax.random.PRNGKey(req.seed),
+                  temperature=req.temperature)
+    return generate.generate(params, jnp.asarray(req.prompt)[None], cfg,
+                             req.max_new, **kw)[0].tolist()
+
+
+class _Clock:
+    """Monotonic seconds since start, with idle fast-forward (module
+    docstring): `now` advances with wall time; `fast_forward` adds the gap
+    to the next arrival without sleeping through it."""
+
+    def __init__(self):
+        self._t0 = time.monotonic()
+        self._skew = 0.0
+
+    def now(self) -> float:
+        return time.monotonic() - self._t0 + self._skew
+
+    def fast_forward(self, to: float) -> None:
+        self._skew += max(0.0, to - self.now())
+
+
+@dataclass
+class ServingReport:
+    """One serving run's outcome: per-request records + the aggregate row."""
+    records: Dict[str, RequestRecord]
+    aggregates: dict
+    wall_s: float
+    peak_blocks_in_use: int
+    pool_blocks: int
+    pool_bytes: int = 0
+    naive_bytes_at_peak: int = 0
+    peak_concurrency: int = 0
+    requests: List[Request] = field(default_factory=list)
+
+
+def aggregate_latency(records: Dict[str, RequestRecord],
+                      busy_span_s: Optional[float] = None) -> dict:
+    """p50/p95/p99 queue wait + TTFT, per-request tok/s, and the sustained
+    throughput — the serving row's numbers, shared by bench.py,
+    serving_bench and the tests so no consumer re-derives them
+    differently. ``busy_span_s`` (run_serving supplies it) is the
+    engine's accumulated working time; without it the fallback span is
+    first admission → last completion, which is only honest when the
+    clock contains no fast-forwarded idle gaps (record timestamps come
+    from the skewed clock, so under sparse load the fallback would count
+    jumped idle time as serving time and deflate the figure)."""
+    done = [r for r in records.values() if r.done_t is not None]
+    if not done:
+        return {"completed": 0}
+    waits = [r.queue_wait_s for r in done if r.queue_wait_s is not None]
+    ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+    rates = [r.tokens_per_sec for r in done if r.tokens_per_sec is not None]
+    total_tokens = sum(len(r.tokens) for r in done)
+    span = busy_span_s if busy_span_s is not None else (
+        max(r.done_t for r in done)
+        - min(r.admit_t for r in done if r.admit_t is not None))
+    pct = lambda vals: {f"p{q:g}": percentile(vals, q)
+                        for q in (50, 95, 99)} if vals else {}
+    return {
+        "completed": len(done),
+        "total_tokens": total_tokens,
+        "sustained_tokens_per_sec": (total_tokens / span if span > 0
+                                     else None),
+        "busy_span_s": span,
+        "queue_wait_s": pct(waits),
+        "ttft_s": pct(ttfts),
+        "request_tokens_per_sec": pct(rates),
+    }
+
+
+def run_serving(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
+                workload: Sequence[Request], *, num_slots: int,
+                prefill_chunk: int = 16, top_k: Optional[int] = None,
+                top_p: Optional[float] = None,
+                events: Optional[EventLog] = None,
+                token_events: bool = True) -> ServingReport:
+    """Replay ``workload`` (arrival offsets in seconds) through a fresh
+    engine + scheduler; returns per-request records and the aggregate row.
+    Every request is guaranteed retired on return — reservation-based
+    admission cannot deadlock (scheduler.py), so the loop's only exit is
+    completion."""
+    engine = Engine(params, cfg, paged, num_slots,
+                    prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p)
+    clock = _Clock()
+    sched = Scheduler(engine, events=events, token_events=token_events,
+                      clock=clock.now)
+    pending = sorted(workload, key=lambda r: r.arrival)
+    busy_s = 0.0       # real working time, fast-forwarded idle excluded —
+    i = 0              # the denominator of sustained tok/s
+    while i < len(pending) or sched.outstanding:
+        now = clock.now()
+        while i < len(pending) and pending[i].arrival <= now:
+            sched.submit(pending[i], now=now)
+            i += 1
+        if sched.outstanding == 0:
+            clock.fast_forward(pending[i].arrival)   # idle: jump, don't sleep
+            continue
+        sched.tick()
+        busy_s += clock.now() - now
+    peak_conc = sched.peak_in_flight   # recorded at admission (scheduler.py)
+    report = ServingReport(
+        records=sched.records,
+        aggregates=aggregate_latency(sched.records, busy_span_s=busy_s),
+        wall_s=clock.now(),
+        peak_blocks_in_use=engine.allocator.peak_in_use,
+        pool_blocks=engine.allocator.capacity,
+        pool_bytes=pool_bytes(cfg, paged),
+        naive_bytes_at_peak=naive_cache_bytes(
+            cfg, max(1, peak_conc), paged.max_seq_len, paged.kv_dtype),
+        peak_concurrency=peak_conc,
+        requests=list(workload))
+    return report

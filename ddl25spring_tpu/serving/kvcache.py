@@ -1,0 +1,166 @@
+"""Paged KV cache: a fixed-size device block pool + a host-side allocator.
+
+The serving-side answer to `models/generate.py`'s whole-batch cache
+(ISSUE 6 tentpole, ROADMAP item 2): `generate()` gives every request its
+own ``[L, B, max_len, H, Dh]`` cache sized for the worst case, so N
+concurrent mixed-length streams pay N · max_len positions of HBM whether
+they use them or not. Here sequences share ONE pool of fixed-size blocks
+(vLLM's PagedAttention allocation scheme, mapped onto this repo's
+static-shape/one-compile discipline):
+
+- The device side is a pair of static-shape arrays ``[L, num_blocks,
+  block_len, H, Dh]`` (layer-major, so the engine's per-layer ``lax.scan``
+  threads one block-pool slice per layer exactly like ``generate``'s cache).
+  ``kv_dtype`` reuses ``init_cache``'s storage-dtype option: bf16 blocks
+  halve the decode loop's dominant HBM stream (experiments/ROOFLINE.md,
+  decode section — the batch-32 KV-bound regime is the serving case).
+- The host side is a free-list allocator handing out block *indices*; each
+  live sequence owns a row of a ``[num_slots, max_blocks_per_seq]`` block
+  table mapping its logical positions to pool blocks. Attention gathers a
+  sequence's blocks through its table row, so physical placement never
+  affects the math (pinned bitwise in tests/test_serving.py).
+- Block 0 is reserved as the TRASH block: inactive slots and padded
+  prefill tail tokens route their cache *writes* there (a static-shape
+  program always writes somewhere), and unallocated table entries point at
+  it. Garbage in trash is never read un-masked — decode attention masks by
+  absolute position (``kpos <= pos``), the same invariant that makes
+  ``generate``'s unwritten cache tail safe.
+
+Sizing math (docs/COMPONENTS.md "Serving" carries the worked example):
+one block holds ``2 · L · block_len · H · Dh · itemsize`` bytes of K+V;
+a request of prompt ``P`` generating ``M`` tokens writes positions
+``0..P+M-2`` (the final sampled token is never fed back — same horizon as
+``generate``'s scan) and therefore needs ``ceil((P+M-1)/block_len)``
+blocks. The pool is intentionally sized BELOW peak naive demand
+(N_concurrent · max_len): admission control queues requests the free list
+cannot cover, and retirement frees blocks at the next token boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..config import LlamaConfig
+
+# Block index 0 is never allocated: it absorbs the writes of inactive
+# slots / padded prefill tails so every compiled step can write
+# unconditionally at a static shape.
+TRASH_BLOCK = 0
+
+
+@dataclass(frozen=True)
+class PagedKVConfig:
+    """Pool geometry. ``num_blocks`` INCLUDES the reserved trash block, so
+    ``num_blocks - 1`` blocks are allocatable. ``max_blocks_per_seq``
+    bounds one sequence's block-table row; ``max_seq_len`` is the longest
+    prompt+generation the engine can serve (and the padded length every
+    attention gather sees — one compile, any mix of live lengths)."""
+
+    num_blocks: int
+    block_len: int
+    max_blocks_per_seq: int
+    kv_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError(f"num_blocks={self.num_blocks}: need at least "
+                             "one allocatable block beside the trash block")
+        if self.block_len < 1 or self.max_blocks_per_seq < 1:
+            raise ValueError(f"bad pool geometry: {self}")
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.block_len * self.max_blocks_per_seq
+
+
+def blocks_for(n_tokens: int, block_len: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache positions."""
+    return -(-max(0, n_tokens) // block_len)
+
+
+def init_pool(cfg: LlamaConfig, paged: PagedKVConfig) -> dict:
+    """Zeroed block pool: {"k","v"} each [L, num_blocks, block_len, H, Dh].
+    Layer-major for the same reason ``init_cache`` is: the engine scans the
+    leading axis, threading one layer's blocks per scan step."""
+    dt = jnp.dtype(paged.kv_dtype or cfg.dtype)
+    shape = (cfg.n_layers, paged.num_blocks, paged.block_len,
+             cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def kv_bytes_per_token(cfg: LlamaConfig,
+                       kv_dtype: Optional[str] = None) -> int:
+    """K+V bytes one cache position occupies across all layers."""
+    dt = jnp.dtype(kv_dtype or cfg.dtype)
+    return 2 * cfg.n_layers * cfg.num_heads * cfg.head_dim * dt.itemsize
+
+
+def pool_bytes(cfg: LlamaConfig, paged: PagedKVConfig) -> int:
+    """Total device bytes of the block pool (the serving KV footprint)."""
+    return (paged.num_blocks * paged.block_len
+            * kv_bytes_per_token(cfg, paged.kv_dtype))
+
+
+def naive_cache_bytes(cfg: LlamaConfig, n_streams: int, max_len: int,
+                      kv_dtype: Optional[str] = None) -> int:
+    """What ``generate`` would allocate for ``n_streams`` concurrent
+    requests: one whole ``max_len`` cache each. The smoke asserts
+    ``pool_bytes < naive_cache_bytes`` at peak concurrency — the paged
+    pool's reason to exist."""
+    return n_streams * max_len * kv_bytes_per_token(cfg, kv_dtype)
+
+
+class BlockAllocator:
+    """Host-side free list over block indices ``1..num_blocks-1``.
+
+    ``alloc`` is all-or-nothing (a sequence's full reservation or None) so
+    admission control can never strand a half-provisioned request — the
+    liveness argument in scheduler.py rests on this. Lowest-index-first
+    hand-out keeps runs reproducible; block identity never reaches the
+    math (attention gathers through the table), so the order is a
+    debugging nicety, not a correctness requirement.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks={num_blocks}: nothing to allocate")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))   # pop() -> lowest
+        self.peak_in_use = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks, or None if the pool cannot cover them (caller
+        queues — never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
+        return got
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 1 <= b < self.num_blocks:
+                raise ValueError(f"free({b}): not an allocatable block")
+            if b in self._free:
+                raise ValueError(f"free({b}): double free")
+        # Re-sort so the free list stays lowest-first regardless of
+        # retirement order — allocation traces depend only on the
+        # alloc/free sequence, not on which request finished first.
+        self._free = sorted(set(self._free) | set(blocks), reverse=True)

@@ -1,0 +1,214 @@
+"""Continuous-batching scheduler: admit/retire at token boundaries.
+
+Orca-style iteration-level scheduling over the slot engine (engine.py):
+instead of freezing a batch for a whole generation (`generate()`'s scan),
+the scheduler revisits the batch at EVERY token boundary — admitting queued
+requests into freed slots, advancing one prefill chunk, decoding one token
+for everyone in flight, and retiring finished sequences (their blocks
+return to the pool immediately).
+
+Admission policy — FCFS with worst-case reservation (the documented seam):
+- ``admit`` reserves a request's worst-case block count up front
+  (``Engine.required_blocks``), all-or-nothing. An admitted request can
+  therefore ALWAYS run to completion: pool exhaustion can only delay
+  admissions, never strand in-flight work, so there is no deadlock and no
+  need for mid-flight preemption — the liveness bar the serving smoke
+  pins (`experiments/serving_bench.py` completes every request with the
+  pool sized below peak naive demand). The cost is utilization: blocks a
+  short-stopping request never writes sit reserved until retirement.
+  vLLM's alternative — allocate lazily per block, preempt-and-recompute a
+  victim on exhaustion — buys that utilization back at the price of
+  recompute; swap `_try_admit` (and add victim selection) to explore it.
+- Strict FCFS: the queue head blocks the line even when a smaller request
+  behind it would fit. Keeping arrival order makes queue-wait percentiles
+  meaningful under the Poisson load harness; size-aware admission is a
+  one-line change at the same seam.
+
+Admission order is a LATENCY decision only: per-slot state (position, RNG
+key, temperature) is carried per sequence and every engine op is
+row-independent, so WHICH slot a request lands in — or who shares a step
+with it — never changes its tokens (the bitwise bar in
+tests/test_serving.py::test_admission_order_does_not_change_tokens).
+
+Telemetry: every lifecycle edge emits a ``request_*`` event (schema v2,
+telemetry/events.py) through the shared JSONL stream — queue wait, TTFT,
+per-token progress, blocks held — rendered as p50/p95/p99 by
+`experiments/obs_report.py`.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..telemetry.events import EventLog
+from .engine import Engine
+
+
+@dataclass(frozen=True)
+class Request:
+    """One generation request. ``seed`` feeds ``jax.random.PRNGKey`` when
+    ``temperature > 0`` (equal seed ⇒ the stream ``generate()`` would emit
+    alone). ``arrival`` is an offset in seconds from workload start — the
+    load harness's Poisson schedule, ignored by direct submitters."""
+    rid: str
+    prompt: Tuple[int, ...]
+    max_new: int
+    temperature: float = 0.0
+    seed: int = 0
+    arrival: float = 0.0
+
+
+@dataclass
+class RequestRecord:
+    """Per-request lifecycle + emitted tokens (the scheduler's ground truth
+    for the zero-dropped/zero-duplicated assertion)."""
+    rid: str
+    prompt_len: int
+    max_new: int
+    blocks: int = 0
+    enqueue_t: Optional[float] = None
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.admit_t is None or self.enqueue_t is None:
+            return None
+        return self.admit_t - self.enqueue_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None or self.enqueue_t is None:
+            return None
+        return self.first_token_t - self.enqueue_t
+
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        if self.done_t is None or self.admit_t is None:
+            return None
+        dt = self.done_t - self.admit_t
+        return len(self.tokens) / dt if dt > 0 else None
+
+
+class Scheduler:
+    """FCFS continuous batching over one Engine.
+
+    >>> sched = Scheduler(engine, events=telemetry.events)
+    >>> sched.submit(req, now=0.0)
+    >>> while sched.outstanding:
+    ...     sched.tick()
+    >>> sched.records[req.rid].tokens
+    """
+
+    policy = "fcfs"   # admission-policy seam (module docstring)
+
+    def __init__(self, engine: Engine, *, events: Optional[EventLog] = None,
+                 token_events: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.events = events
+        self.token_events = token_events
+        self.clock = clock
+        self.queue: List[Request] = []
+        self.records: Dict[str, RequestRecord] = {}
+        self._by_slot: Dict[int, Request] = {}
+        self.completed = 0
+        # High-water mark of in-flight requests, recorded AT admission —
+        # the instant concurrency peaks. An end-of-tick sample would
+        # undercount whenever a fully-loaded step also retires someone.
+        self.peak_in_flight = 0
+
+    # -------------------------------------------------------------- lifecycle
+    def submit(self, req: Request, now: Optional[float] = None) -> None:
+        """Enqueue; raises for a request NO pool state could ever serve
+        (so the queue can never hold an unadmittable head — the liveness
+        precondition)."""
+        need = self.engine.required_blocks(len(req.prompt), req.max_new)
+        positions = len(req.prompt) + req.max_new - 1
+        if (need > self.engine.allocator.capacity
+                or positions > self.engine.paged.max_seq_len):
+            raise ValueError(
+                f"{req.rid}: needs {positions} cache positions / {need} "
+                f"blocks but the engine serves at most "
+                f"{self.engine.paged.max_seq_len} positions / "
+                f"{self.engine.allocator.capacity} blocks — oversized for "
+                "this engine at any load")
+        now = self.clock() if now is None else now
+        self.queue.append(req)
+        self.records[req.rid] = RequestRecord(
+            rid=req.rid, prompt_len=len(req.prompt), max_new=req.max_new,
+            blocks=need, enqueue_t=now)
+        if self.events:
+            self.events.request_enqueue(
+                req=req.rid, prompt_len=len(req.prompt), max_new=req.max_new,
+                temperature=req.temperature, queued=len(self.queue))
+
+    @property
+    def outstanding(self) -> int:
+        """Requests not yet retired (queued + in flight)."""
+        return len(self.queue) + len(self._by_slot)
+
+    def tick(self) -> List[Tuple[str, int]]:
+        """One token boundary: admit, advance the engine, retire. Returns
+        the (rid, token) pairs emitted this boundary."""
+        self._admit()
+        if not self.engine.busy:
+            return []
+        emitted: List[Tuple[str, int]] = []
+        events = self.engine.step()
+        now = self.clock()   # post-step: token timestamps include the step
+        for ev in events:
+            req = self._by_slot[ev.slot]
+            rec = self.records[req.rid]
+            rec.tokens.append(ev.token)
+            if ev.first:
+                rec.first_token_t = now
+            if self.events and self.token_events:
+                self.events.request_token(req=req.rid,
+                                          i=len(rec.tokens) - 1,
+                                          tok=ev.token, slot=ev.slot)
+            if ev.done:
+                rec.done_t = now
+                del self._by_slot[ev.slot]
+                self.completed += 1
+                if self.events:
+                    self.events.request_done(
+                        req=req.rid, tokens=len(rec.tokens),
+                        queue_wait_s=rec.queue_wait_s, ttft_s=rec.ttft_s,
+                        tokens_per_sec=rec.tokens_per_sec,
+                        blocks_freed=rec.blocks,
+                        blocks_in_use=self.engine.blocks_in_use())
+            emitted.append((req.rid, ev.token))
+        return emitted
+
+    # -------------------------------------------------------------- admission
+    def _admit(self) -> None:
+        """Strict FCFS: admit from the head while it fits; stop at the
+        first that doesn't (policy seam — see module docstring)."""
+        while self.queue:
+            head = self.queue[0]
+            if not self.engine.can_admit(len(head.prompt), head.max_new):
+                return
+            self.queue.pop(0)
+            key = (jax.random.PRNGKey(head.seed)
+                   if head.temperature > 0 else None)
+            slot = self.engine.admit(np.asarray(head.prompt, np.int32),
+                                     head.max_new,
+                                     temperature=head.temperature, key=key)
+            self._by_slot[slot] = head
+            self.peak_in_flight = max(self.peak_in_flight,
+                                      len(self._by_slot))
+            rec = self.records[head.rid]
+            rec.admit_t = self.clock()
+            if self.events:
+                self.events.request_prefill(
+                    req=head.rid, slot=slot, blocks=rec.blocks,
+                    queue_wait_s=rec.queue_wait_s,
+                    blocks_in_use=self.engine.blocks_in_use())

@@ -32,12 +32,19 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
-SCHEMA_VERSION = 1
+# v2: serving request lifecycle (request_enqueue / request_prefill /
+# request_token / request_done — serving/scheduler.py). Version bumps are
+# additive: a v2 reader accepts v1 streams unchanged, and v1 readers
+# reject v2 (the "future schema" rule in validate_event) rather than
+# misread it.
+SCHEMA_VERSION = 2
 
 # Event types this schema version defines. Emitters may add new types
 # freely; ``validate_event`` checks base fields for ALL types and the
 # per-type required fields only for the known ones.
-EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh")
+EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
+               "request_enqueue", "request_prefill", "request_token",
+               "request_done")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -51,6 +58,17 @@ _REQUIRED: Dict[str, tuple] = {
     # world size plus path taken ("mirror"/"checkpoint"), seconds lost,
     # and steps replayed; rendered by experiments/obs_report.py.
     "remesh": ("old_world", "new_world"),
+    # Serving request lifecycle (serving/scheduler.py, schema v2). ``req``
+    # is the request id threading all four together. Enqueue carries the
+    # request shape (prompt_len/max_new); prefill marks admission into a
+    # slot (queue_wait_s, blocks reserved + pool blocks_in_use); token is
+    # per-token progress (index ``i``); done closes the request with the
+    # latency summary (queue_wait_s, ttft_s, tokens_per_sec) obs_report
+    # aggregates into p50/p95/p99.
+    "request_enqueue": ("req",),
+    "request_prefill": ("req", "slot"),
+    "request_token": ("req", "i"),
+    "request_done": ("req", "tokens"),
 }
 
 
@@ -182,6 +200,21 @@ class EventLog:
                **fields) -> Dict[str, Any]:
         return self.emit("remesh", old_world=old_world, new_world=new_world,
                          **fields)
+
+    # Serving request lifecycle (schema v2; serving/scheduler.py emits).
+    def request_enqueue(self, *, req: str, **fields) -> Dict[str, Any]:
+        return self.emit("request_enqueue", req=req, **fields)
+
+    def request_prefill(self, *, req: str, slot: int,
+                        **fields) -> Dict[str, Any]:
+        return self.emit("request_prefill", req=req, slot=slot, **fields)
+
+    def request_token(self, *, req: str, i: int, **fields) -> Dict[str, Any]:
+        return self.emit("request_token", req=req, i=i, **fields)
+
+    def request_done(self, *, req: str, tokens: int,
+                     **fields) -> Dict[str, Any]:
+        return self.emit("request_done", req=req, tokens=tokens, **fields)
 
     def close(self) -> None:
         with self._lock:

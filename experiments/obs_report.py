@@ -61,6 +61,10 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
     faults = by_type.get("fault", [])
     rounds = by_type.get("fl_round", [])
     remeshes = by_type.get("remesh", [])
+    req_enq = by_type.get("request_enqueue", [])
+    req_pre = by_type.get("request_prefill", [])
+    req_tok = by_type.get("request_token", [])
+    req_done = by_type.get("request_done", [])
 
     _section("run")
     print(f"run_id: {events[0].get('run_id')}   events: {len(events)}")
@@ -100,6 +104,37 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
             print("step time: " + "  ".join(
                 f"p{q:g}={percentile(dts, q) * 1e3:.1f}ms"
                 for q in (50, 95, 99)) + f"  n={len(dts)} windows")
+
+    if req_enq or req_pre or req_done or req_tok:
+        # Serving section (schema v2 request_* events, serving/scheduler.py).
+        # Runs with no serving events skip this silently — training and
+        # serving streams share one schema, not one workload.
+        _section("serving")
+        print(f"requests: {len(req_enq)} enqueued   {len(req_pre)} admitted"
+              f"   {len(req_done)} done   {len(req_tok)} token events")
+        waits = [e["queue_wait_s"] for e in req_done
+                 if isinstance(e.get("queue_wait_s"), (int, float))]
+        ttfts = [e["ttft_s"] for e in req_done
+                 if isinstance(e.get("ttft_s"), (int, float))]
+        for label, vals, unit in (("queue wait", waits, 1e3),
+                                  ("ttft", ttfts, 1e3)):
+            if vals:
+                print(f"{label}: " + "  ".join(
+                    f"p{q:g}={percentile(vals, q) * unit:.1f}ms"
+                    for q in (50, 95, 99)) + f"  n={len(vals)}")
+        total_tokens = sum(e["tokens"] for e in req_done
+                           if isinstance(e.get("tokens"), int))
+        if req_done and req_pre:
+            # Busy-span throughput from the stream's own timestamps:
+            # first admission -> last completion.
+            span = max(e["t"] for e in req_done) - min(e["t"] for e in req_pre)
+            if span > 0:
+                print(f"sustained: {total_tokens / span:,.1f} tok/s "
+                      f"({total_tokens} tokens over {span:.2f}s busy span)")
+        blocks = [e["blocks_in_use"] for e in req_pre + req_done
+                  if isinstance(e.get("blocks_in_use"), int)]
+        if blocks:
+            print(f"peak blocks in use: {max(blocks)}")
 
     if remeshes:
         _section("remesh (elastic recoveries)")

@@ -156,6 +156,120 @@ def test_generate_with_sharded_params_and_batch(params, devices):
     assert jnp.array_equal(want, got)
 
 
+def test_generate_oversized_request_raises(params):
+    """prompt_len + max_new_tokens > max_len must be a clear ValueError,
+    not a silent out-of-range cache write (dynamic_update_slice would clamp
+    the start index and OVERWRITE earlier positions, producing garbage tail
+    tokens)."""
+    prompt = jnp.zeros((1, 6), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        generate.generate(params, prompt, CFG, 4, max_len=8)   # needs 10
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate.generate(params, prompt, CFG, 0)
+    # The boundary case fits exactly and must NOT raise.
+    out = generate.generate(params, prompt, CFG, 4, max_len=10)
+    assert out.shape == (1, 4)
+
+
+# ----------------------------------------------------- serving-engine parity
+# The slot-based prefill()/decode_step() engine (ddl25spring_tpu/serving)
+# re-arranges this module's math over a paged block pool; these tests pin
+# that it reproduces generate() TOKEN-FOR-TOKEN at equal seeds — the
+# serving subsystem's correctness bar (ISSUE 6).
+
+def _paged():
+    from ddl25spring_tpu.serving import PagedKVConfig
+    return PagedKVConfig(num_blocks=32, block_len=4, max_blocks_per_seq=8)
+
+
+def _engine_streams(params, requests, *, num_slots, prefill_chunk,
+                    top_k=None, top_p=None):
+    """Run ragged ``(prompt, max_new, temperature, seed)`` requests in ONE
+    slot batch; returns each slot's emitted tokens."""
+    import numpy as np
+
+    from ddl25spring_tpu.serving import Engine
+    eng = Engine(params, CFG, _paged(), num_slots,
+                 prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p)
+    slots = {}
+    for i, (prompt, mx, temp, seed) in enumerate(requests):
+        key = jax.random.PRNGKey(seed) if temp > 0 else None
+        s = eng.admit(np.asarray(prompt, np.int32), mx, temperature=temp,
+                      key=key)
+        slots[i] = s
+    toks = {s: [] for s in slots.values()}
+    while eng.busy:
+        for ev in eng.step():
+            toks[ev.slot].append(ev.token)
+    return [toks[slots[i]] for i in range(len(requests))]
+
+
+def _generate_stream(params, prompt, mx, temp, seed, *, top_k=None,
+                     top_p=None):
+    # The ONE reference-construction helper (serving/frontend.py) — the
+    # rules that make the parity bar valid (max_len/kv_dtype pinned to the
+    # pool, key only when sampling) must not be re-derived here.
+    from ddl25spring_tpu.serving import Request, reference_stream
+    req = Request(rid="ref", prompt=tuple(int(t) for t in prompt),
+                  max_new=mx, temperature=temp, seed=seed)
+    return reference_stream(params, CFG, _paged(), req, top_k=top_k,
+                            top_p=top_p)
+
+
+def test_slot_engine_matches_generate_greedy_bitwise(params):
+    """Ragged greedy prompts sharing one slot batch: each stream must be
+    BITWISE the stream generate() emits for that request alone."""
+    rng = jax.random.PRNGKey(21)
+    reqs = []
+    for i, (tp, mx) in enumerate([(3, 6), (9, 4), (5, 8)]):
+        rng, sub = jax.random.split(rng)
+        prompt = jax.random.randint(sub, (tp,), 0, CFG.vocab_size).tolist()
+        reqs.append((prompt, mx, 0.0, 0))
+    got = _engine_streams(params, reqs, num_slots=3, prefill_chunk=4)
+    for (prompt, mx, temp, seed), stream in zip(reqs, got):
+        assert stream == _generate_stream(params, prompt, mx, temp, seed)
+
+
+def test_slot_engine_matches_generate_sampled_bitwise(params):
+    """Temperature sampling at equal seeds, mixed with a greedy neighbor in
+    the same batch: per-slot RNG keys must reproduce generate()'s exact
+    split sequence regardless of batch company."""
+    reqs = [([5, 17, 3], 6, 0.8, 13),
+            ([2, 9, 41, 7, 30, 11, 4], 5, 0.6, 99),
+            ([8, 8], 7, 0.0, 0)]
+    got = _engine_streams(params, reqs, num_slots=3, prefill_chunk=4)
+    for (prompt, mx, temp, seed), stream in zip(reqs, got):
+        assert stream == _generate_stream(params, prompt, mx, temp, seed)
+
+
+def test_slot_engine_chunked_prefill_matches_whole_prompt(params):
+    """A prompt split over several prefill chunks (chunk < prompt_len) must
+    emit the same stream as one-shot prefill — chunking is a latency
+    decision, not a math change. Also pins the RNG discipline: the key
+    splits ONCE per prefill no matter how many chunks carry it."""
+    prompt = [int(x) for x in
+              jax.random.randint(jax.random.PRNGKey(5), (11,), 0,
+                                 CFG.vocab_size)]
+    want_greedy = _generate_stream(params, prompt, 6, 0.0, 0)
+    want_sampled = _generate_stream(params, prompt, 6, 0.9, 42)
+    for chunk in (2, 3, 16):       # straddling, uneven, single-chunk
+        got = _engine_streams(params, [(prompt, 6, 0.0, 0),
+                                       (prompt, 6, 0.9, 42)],
+                              num_slots=2, prefill_chunk=chunk)
+        assert got[0] == want_greedy, chunk
+        assert got[1] == want_sampled, chunk
+
+
+def test_slot_engine_matches_generate_with_top_k_top_p(params):
+    """The static top_k/top_p filters compose identically on both paths."""
+    reqs = [([1, 2, 3], 5, 0.8, 3), ([4, 5], 4, 0.7, 8)]
+    got = _engine_streams(params, reqs, num_slots=2, prefill_chunk=4,
+                          top_k=7, top_p=0.9)
+    for (prompt, mx, temp, seed), stream in zip(reqs, got):
+        assert stream == _generate_stream(params, prompt, mx, temp, seed,
+                                          top_k=7, top_p=0.9)
+
+
 def test_bf16_kv_cache_close_to_fp32(params):
     """kv_dtype="bfloat16" halves cache storage (the serving lever measured
     in bench.py's decode sidebar); the decode must stay the same computation

@@ -207,6 +207,47 @@ def test_validate_event_contract():
     assert validate_event({"type": "step", "it": 0}) != []
 
 
+def test_request_event_emitters_roundtrip(tmp_path):
+    """Schema v2: the serving lifecycle's four typed emitters produce
+    valid, strictly-readable events carrying their required fields."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="srv") as log:
+        log.request_enqueue(req="r-1", prompt_len=8, max_new=4,
+                            temperature=0.8, queued=1)
+        log.request_prefill(req="r-1", slot=2, blocks=3, queue_wait_s=0.01,
+                            blocks_in_use=3)
+        log.request_token(req="r-1", i=0, tok=17, slot=2)
+        log.request_done(req="r-1", tokens=4, queue_wait_s=0.01,
+                         ttft_s=0.05, tokens_per_sec=80.0, blocks_freed=3,
+                         blocks_in_use=0)
+    events = read_events(path, strict=True)    # strict = validate_event
+    assert [e["type"] for e in events] == [
+        "request_enqueue", "request_prefill", "request_token",
+        "request_done"]
+    assert all(e["schema"] == SCHEMA_VERSION for e in events)
+    assert events[1]["slot"] == 2 and events[3]["tokens"] == 4
+
+
+def test_validate_event_request_required_fields():
+    """request_* events missing their per-type required fields must be
+    flagged — the schema bump added real rows, not just names."""
+    base = {"schema": SCHEMA_VERSION, "run_id": "r", "seq": 1, "t": 0.0}
+    assert validate_event({**base, "type": "request_enqueue",
+                           "req": "a"}) == []
+    assert validate_event({**base, "type": "request_enqueue"}) != []
+    assert validate_event({**base, "type": "request_prefill",
+                           "req": "a"}) != []        # missing slot
+    assert validate_event({**base, "type": "request_token",
+                           "req": "a"}) != []        # missing i
+    assert validate_event({**base, "type": "request_done",
+                           "req": "a"}) != []        # missing tokens
+    assert validate_event({**base, "type": "request_done", "req": "a",
+                           "tokens": 3}) == []
+    # v1 streams (all pre-serving types) remain valid under the v2 reader.
+    assert validate_event({**base, "schema": 1, "type": "step",
+                           "it": 0}) == []
+
+
 def test_eventlog_concurrent_writers(tmp_path):
     """10 threads x 50 events through one log: every event lands intact
     (one write() under the lock), seq is a permutation of 1..500."""
