@@ -499,6 +499,43 @@ def main():
         print(f"serving bench: failed ({type(e).__name__}: {e})",
               file=sys.stderr)
 
+    # Fleet FL row (ddl25spring_tpu/fl/fleet.py): clients/sec through one
+    # cohort-streamed FedAvg round — the round-throughput number that
+    # decides how many simulated users a round can cover in a deadline.
+    # Same isolation contract as the sidebars above (stderr, never sinks
+    # the bench). Synthetic procedural clients, so the figure is about
+    # the engine (dispatch + local solve + fold), not a data pipeline.
+    try:
+        import time
+
+        import jax.numpy as jnp
+
+        from ddl25spring_tpu.config import FLConfig
+        from ddl25spring_tpu.fl import (FleetConfig, FleetFedAvgServer,
+                                        SyntheticFleetSource)
+        n_clients = 2_000 if QUICK else 20_000
+        fsrc = SyntheticFleetSource(n_clients, samples_per_client=8,
+                                    features=64, classes=16, seed=0)
+        fxt, fyt = fsrc.test_set(256)
+        fparams = {"w": 0.01 * jax.random.normal(jax.random.key(0),
+                                                 (64, 16)),
+                   "b": jnp.zeros((16,))}
+        fcfg = FLConfig(nr_clients=n_clients, client_fraction=1.0,
+                        batch_size=8, epochs=1, lr=0.5, seed=0)
+        fsrv = FleetFedAvgServer(
+            fparams, lambda p, x, key=None: x @ p["w"] + p["b"],
+            fsrc, fxt, fyt, fcfg, FleetConfig(cohort_width=64))
+        jax.block_until_ready(fsrv._round(fparams, 0))   # warm (compile)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fsrv._round(fparams, 0))
+        fleet_s = time.perf_counter() - t0
+        print(f"fleet FL round, {n_clients} clients @ cohort 64: "
+              f"{n_clients / fleet_s:10.0f} clients/s",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"fleet bench: failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] == "--one":

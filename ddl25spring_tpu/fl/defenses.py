@@ -61,6 +61,27 @@ def stack_flat(deltas: PyTree) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], PyT
     return flat, unflatten
 
 
+def unstack_flat(flat: jnp.ndarray, template: PyTree) -> PyTree:
+    """Inverse of ``stack_flat`` for a whole [m, P] stack: rebuild the
+    stacked pytree (leading client axis m) whose per-leaf trailing shapes
+    come from ``template`` (a single un-stacked pytree, e.g. the params).
+
+    The fleet engine (fl/fleet.py) streams per-client deltas off-device as
+    flat rows and hands defenses the SAME stacked-tree shape the vmapped
+    servers produce; round-tripping through stack_flat is pure
+    reshape/concatenate, so the rebuilt stack is bitwise the original."""
+    leaves = jax.tree.leaves(template)
+    treedef = jax.tree.structure(template)
+    m = flat.shape[0]
+    parts = []
+    off = 0
+    for leaf in leaves:
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        parts.append(flat[:, off:off + size].reshape((m,) + leaf.shape))
+        off += size
+    return jax.tree.unflatten(treedef, parts)
+
+
 # ------------------------------------------------------------ selection rules
 
 def krum_scores(flat: jnp.ndarray, n_malicious: int) -> jnp.ndarray:
@@ -171,25 +192,40 @@ def sparse_fed(flat: jnp.ndarray, topk_fraction: float, *, clip_ratio: float = 1
 
 def selection_defense(rule: Callable[..., jnp.ndarray], **kw) -> Callable:
     """Wrap a selection rule (returns indices) — survivors are re-weighted by
-    their sample counts, like FedAvgServerDefense (cell 34)."""
+    their sample counts, like FedAvgServerDefense (cell 34).
 
-    def hook(deltas: PyTree, weights: jnp.ndarray) -> PyTree:
-        flat, unflatten = stack_flat(deltas)
+    The returned hook carries its flat [m, P] → [P] core as
+    ``hook.flat_hook``: consumers that already hold the flat stack (the
+    fleet engine streams per-client deltas off-device as flat rows) apply
+    it directly instead of round-tripping through the stacked pytree —
+    same ops, so both entry points agree bitwise."""
+
+    def flat_hook(flat: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
         idx = jnp.atleast_1d(rule(flat, **kw))
         w = weights[idx]
         w = w / jnp.maximum(w.sum(), 1e-12)
-        agg = (flat[idx] * w[:, None]).sum(axis=0)
-        return unflatten(agg)
+        return (flat[idx] * w[:, None]).sum(axis=0)
 
+    def hook(deltas: PyTree, weights: jnp.ndarray) -> PyTree:
+        flat, unflatten = stack_flat(deltas)
+        return unflatten(flat_hook(flat, weights))
+
+    hook.flat_hook = flat_hook
     return hook
 
 
 def coordinate_defense(rule: Callable[..., jnp.ndarray], **kw) -> Callable:
     """Wrap an aggregation rule operating on the flat [m, P] stack — the
-    FedAvgServerDefenseCoordinate pattern (cell 43)."""
+    FedAvgServerDefenseCoordinate pattern (cell 43). Carries
+    ``hook.flat_hook`` like ``selection_defense`` (weights unused — the
+    coordinate rules replace the weighted mean entirely)."""
+
+    def flat_hook(flat: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+        return rule(flat, **kw)
 
     def hook(deltas: PyTree, weights: jnp.ndarray) -> PyTree:
         flat, unflatten = stack_flat(deltas)
-        return unflatten(rule(flat, **kw))
+        return unflatten(flat_hook(flat, weights))
 
+    hook.flat_hook = flat_hook
     return hook

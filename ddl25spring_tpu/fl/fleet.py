@@ -1,0 +1,592 @@
+"""Fleet-scale federated learning: cohort-streaming rounds + two-tier
+hierarchical aggregation (ISSUE 7 tentpole; ROADMAP item 4).
+
+Every other FL server in this package vmaps ALL sampled clients
+device-resident per round — fine for the course's 100 clients, impossible
+for the north star's millions: a round's device memory is
+O(clients · (subset + params)). This module replaces that with a
+**cohort-streaming round engine**:
+
+- a round samples its clients on the host, then streams them through a
+  FIXED-width device cohort axis: one compiled ``cohort step`` per cohort
+  width, vmapping W clients at a time;
+- the running aggregate is carried across cohorts as a device pytree and
+  folded SEQUENTIALLY (pt.tree_weighted_fold), so a round's device memory
+  is O(cohort), not O(clients) — and, because a chunked left fold from a
+  carried init is bitwise the one-shot fold, the streamed round is
+  BITWISE-equal to the vmapped path at equal cohort content, at ANY
+  cohort width (``vmapped_round_reference`` is that path; pinned in
+  tests/test_fleet.py and checked end-to-end by
+  experiments/fleet_smoke.py on a 100k-client round);
+- the last cohort pads to width W with zero-weight duplicates — the fold
+  selects around weight-0 rows exactly, so padding is invisible and the
+  engine never retraces.
+
+On top of the streaming engine sits a **two-tier hierarchical mode**
+(``FleetConfig.edges = E > 1``): the sampled clients are partitioned over
+E edge aggregators, each edge streams its own cohorts to an edge
+aggregate, and a server tier reduces the E edge results. Defenses
+(fl/defenses.py hooks), secure aggregation (fl/secure_agg.py pairwise
+masking) and DP (fl/privacy.py clipping + noise) each apply *per tier*
+via ``TierPolicy`` — an edge defends/masks/noises its own clients, the
+server tier defends/noises the edge aggregates. ``edges=1`` with empty
+policies IS the flat path (no server-tier reduction runs), so flat vs
+hierarchical is a config axis, not a code fork. Weighting semantics:
+every client carries its GLOBAL FedAvg weight only in the flat case; in
+the hierarchical case edges normalize internally (c_i/S_e) and the
+server weighs edges by their sample mass (S_e/S) — mathematically equal
+to flat FedAvg, exact where the reduction order permits (E=1), a
+documented ~1e-7 float-association tolerance otherwise.
+
+Client data never lives device-resident in bulk: a ``source`` object
+materializes cohorts on demand (``FederatedArraySource`` gathers from
+host arrays; ``SyntheticFleetSource`` *generates* each client's subset
+deterministically from its id, so 100k+ simulated clients cost O(cohort)
+bytes ever). Client sampling and the per-(client, round) seed formula are
+the same host-observable machinery as the vmapped servers (rng.py) — a
+client's local randomness does not depend on which path, cohort, or tier
+processed it.
+
+Telemetry (schema v3): one ``fl_cohort`` event per cohort dispatch and
+one ``fl_tier`` event per tier per round, with exact payload-byte
+accounting (telemetry.comm.tree_bytes) of what crossed into each tier —
+m·|Δ| client-uplink bytes into the edges, E·|Δ| edge-uplink bytes into
+the server. Defense memory honesty: selection/aggregation defenses need
+the tier's full input stack (Krum's O(n²) distance matrix is over all n
+inputs), so a defended edge collects per-client FLAT deltas host-side —
+O(m_e · P) host floats, still never O(clients · subset) device bytes; the
+streamed stack is bitwise the vmapped one, so the selection matches the
+vmapped reference exactly (the Krum-at-cohort-scale bar).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import rng as rngmod
+from ..config import FLConfig
+from ..telemetry.comm import tree_bytes
+from ..utils import pytree as pt
+from .defenses import stack_flat, unstack_flat
+from .federated_data import FederatedDataset
+from .local import local_sgd
+from .privacy import clip_by_global_norm, gaussian_noise_like
+from .secure_agg import (_MASK_SALT, check_secagg_capacity, dequantize_tree,
+                         masked_upload, secagg_scale)
+from .servers import _ServerBase, _round_weights
+
+PyTree = Any
+
+# Dedicated RNG stream for per-tier DP noise: never derived from client
+# keys (whose linear seed formula collides across rounds) and salted
+# differently from DPFedAvgServer's stream so flat-vs-fleet comparisons
+# at z=0 stay meaningful without aliasing at z>0.
+_FLEET_NOISE_SALT = 0xF1EE7D0E
+
+
+# ------------------------------------------------------------- data sources
+
+class FederatedArraySource:
+    """Streaming adapter over in-memory client arrays: cohorts are host
+    gathers from the stacked [N, S, ...] layout (federated_data.py). The
+    arrays live in HOST numpy — only the gathered cohort is shipped to the
+    device — so this scales to whatever the host holds, and small parity
+    tests can wrap the exact FederatedDataset a vmapped server uses."""
+
+    def __init__(self, data: FederatedDataset):
+        self._x = np.asarray(data.x)
+        self._y = np.asarray(data.y)
+        self._mask = np.asarray(data.mask)
+        self._counts = np.asarray(data.sample_counts)
+
+    @property
+    def nr_clients(self) -> int:
+        return self._x.shape[0]
+
+    def counts(self, idx: np.ndarray) -> np.ndarray:
+        return self._counts[idx]
+
+    def cohort(self, idx: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return self._x[idx], self._y[idx], self._mask[idx]
+
+
+class SyntheticFleetSource:
+    """Procedurally generated clients: each client's subset is a pure
+    function of (seed, client id), materialized only when its cohort is
+    gathered — the 'millions of simulated users' stand-in the fleet smoke
+    streams 100k of at O(cohort) memory.
+
+    The task is learnable on purpose (the smoke's accuracy is a liveness
+    signal, not a benchmark): class prototypes are fixed by the seed,
+    client i draws labels from a 2-class slice of the label space keyed by
+    its id (a mild non-IID skew) and features = prototype + noise."""
+
+    def __init__(self, nr_clients: int, *, samples_per_client: int = 8,
+                 features: int = 16, classes: int = 10, seed: int = 0,
+                 noise: float = 0.3):
+        self.nr_clients = int(nr_clients)
+        self.samples_per_client = int(samples_per_client)
+        self.features = int(features)
+        self.classes = int(classes)
+        self.seed = int(seed)
+        self.noise = float(noise)
+        proto_rng = np.random.default_rng(np.random.SeedSequence([seed]))
+        self.prototypes = proto_rng.normal(
+            size=(classes, features)).astype(np.float32)
+
+    def _client(self, cid: int) -> Tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, int(cid)]))
+        ys = (int(cid) + rng.integers(0, 2, self.samples_per_client)
+              ) % self.classes
+        xs = (self.prototypes[ys]
+              + self.noise * rng.normal(
+                  size=(self.samples_per_client, self.features))
+              ).astype(np.float32)
+        return xs, ys.astype(np.int32)
+
+    def counts(self, idx: np.ndarray) -> np.ndarray:
+        return np.full(len(idx), self.samples_per_client, np.int32)
+
+    def cohort(self, idx: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        xs = np.empty((len(idx), self.samples_per_client, self.features),
+                      np.float32)
+        ys = np.empty((len(idx), self.samples_per_client), np.int32)
+        for row, cid in enumerate(idx):
+            xs[row], ys[row] = self._client(cid)
+        mask = np.ones(ys.shape, np.float32)
+        return xs, ys, mask
+
+    def test_set(self, n: int, seed: int = 1
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """A held-out sample of the same task for the accuracy probe."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.nr_clients + 1, seed]))
+        ys = rng.integers(0, self.classes, n)
+        xs = (self.prototypes[ys]
+              + self.noise * rng.normal(size=(n, self.features))
+              ).astype(np.float32)
+        return xs, ys.astype(np.int32)
+
+
+# ---------------------------------------------------------------- tier policy
+
+@dataclass(frozen=True)
+class TierPolicy:
+    """What one aggregation tier does to its inputs before reducing them.
+
+    - ``defense``: an fl.defenses hook ``(stacked_inputs, weights) -> agg``
+      (selection_defense / coordinate_defense). Edge tier: over the edge's
+      client deltas; server tier: over the edge aggregates. Requires the
+      tier to materialize its input stack (see module docstring).
+    - ``dp_clip`` / ``dp_noise_multiplier``: clip each tier input to the
+      L2 ball, then add Gaussian noise σ = z·clip/n to the tier aggregate
+      (DP-FedAvg per tier; uniform weighting required when z > 0, since
+      sample-count weights make the sensitivity data-dependent; does NOT
+      compose with a defense in the same tier — the σ calibration assumes
+      the plain uniform mean's clip/n sensitivity).
+    - ``secure_agg``: pairwise-masked fixed-point uploads into this tier
+      (edge tier only — the masking is built into the client cohort step);
+      a (clip_norm, bits) tuple. Implies uniform weighting and per-client
+      clipping at clip_norm, matching SecureAggFedAvgServer bitwise at
+      edges=1 (the int32 ring sum is order-free, so streaming is exact).
+    """
+    defense: Optional[Callable] = None
+    dp_clip: Optional[float] = None
+    dp_noise_multiplier: float = 0.0
+    secure_agg: Optional[Tuple[float, int]] = None
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fleet engine knobs, on top of the protocol's FLConfig."""
+    cohort_width: int = 64
+    edges: int = 1
+    weighting: str = "samples"          # "samples" | "uniform"
+    edge: TierPolicy = field(default_factory=TierPolicy)
+    server: TierPolicy = field(default_factory=TierPolicy)
+
+
+# ------------------------------------------------------------ the fleet server
+
+class FleetFedAvgServer(_ServerBase):
+    """Δ-upload FedAvg over a cohort-streaming round engine with an
+    optional edge→server hierarchy (module docstring). Same protocol
+    surface as the vmapped servers: FLConfig hyperparameters, host
+    sampling, per-(client, round) seeds, ``run()``/RunResult/telemetry —
+    only the execution shape differs.
+
+    >>> src = SyntheticFleetSource(100_000)
+    >>> s = FleetFedAvgServer(params, apply_fn, src, xt, yt,
+    ...                       FLConfig(nr_clients=100_000,
+    ...                                client_fraction=1.0),
+    ...                       FleetConfig(cohort_width=64, edges=4))
+    >>> s.run(1)
+    """
+
+    def __init__(self, init_params, apply_fn, source, test_x, test_y,
+                 cfg: FLConfig, fleet: FleetConfig = FleetConfig(), *,
+                 telemetry=None):
+        if fleet.cohort_width < 1:
+            raise ValueError(f"cohort_width={fleet.cohort_width}")
+        if not 1 <= fleet.edges <= cfg.clients_per_round:
+            raise ValueError(
+                f"edges={fleet.edges}: need 1..clients_per_round "
+                f"({cfg.clients_per_round}) — an empty edge aggregates "
+                "nothing")
+        if fleet.weighting not in ("samples", "uniform"):
+            raise ValueError(f"weighting={fleet.weighting!r}")
+        if fleet.server.secure_agg is not None:
+            raise ValueError("secure_agg is an edge-tier (client-upload) "
+                             "mechanism; the server tier sees E edge "
+                             "aggregates, not masked client vectors")
+        for tier, name in ((fleet.edge, "edge"), (fleet.server, "server")):
+            if tier.dp_noise_multiplier > 0 and tier.dp_clip is None:
+                raise ValueError(f"{name}: dp_noise_multiplier > 0 needs "
+                                 "a finite dp_clip")
+            if tier.dp_noise_multiplier > 0 and tier.defense is not None:
+                # σ = z·clip/n calibrates the noise to the UNIFORM mean's
+                # sensitivity (clip/n). A selection defense averages only
+                # k ≤ n survivors — sensitivity clip/k — so the same σ
+                # would silently under-noise by n/k and the reported ε
+                # would overstate the guarantee. Refuse rather than
+                # miscalibrate; defense-aware calibration is future work.
+                raise ValueError(f"{name}: dp_noise_multiplier > 0 does "
+                                 "not compose with a defense — the σ = "
+                                 "z·clip/n calibration assumes the plain "
+                                 "uniform mean's sensitivity")
+        needs_uniform = (fleet.edge.secure_agg is not None
+                         or fleet.edge.dp_noise_multiplier > 0
+                         or fleet.server.dp_noise_multiplier > 0)
+        if needs_uniform and fleet.weighting != "uniform":
+            raise ValueError("secure_agg / DP noise require "
+                             "weighting='uniform' (sample-count weights "
+                             "make the sensitivity data-dependent)")
+        if fleet.edge.secure_agg is not None and (
+                fleet.edge.defense is not None
+                or fleet.edge.dp_clip is not None):
+            raise ValueError("edge secure_agg already clips and hides "
+                             "per-client vectors; it composes with "
+                             "server-tier policies, not with edge "
+                             "defense/dp_clip")
+        # _ServerBase stores ``data`` opaquely (only the vmapped
+        # subclasses' round steps gather from it), so the streaming source
+        # rides in the same slot.
+        super().__init__(init_params, apply_fn, source, test_x, test_y,
+                         cfg, algorithm="fleet-fedavg", telemetry=telemetry)
+        self.source = source
+        self.fleet = fleet
+        self._manifest_extra = {"fleet": dataclasses.asdict(fleet)}
+        # Per-client upload payload, exact from leaf shapes/dtypes: f32
+        # deltas, or the same-width int32 fixed-point tree under secagg.
+        self._client_payload_bytes = tree_bytes(init_params)
+        if fleet.edge.secure_agg is not None:
+            clip_norm, bits = fleet.edge.secure_agg
+            # Capacity at the pair-set size = the largest edge.
+            check_secagg_capacity(bits, self._edge_width(0))
+            self._secagg_scale = secagg_scale(clip_norm, bits)
+        self._collect = (fleet.edge.defense is not None)
+        # [P] → params-shaped tree, for defense hooks' flat results.
+        self._unflatten_vec = stack_flat(
+            jax.tree.map(lambda p: p[None], init_params))[1]
+
+        def delta_client(params, x, y, m, k, clip):
+            """One client's Δ-upload: local_sgd → delta (→ clip) — the
+            same ops as FedAvgGradServer's clients, so streamed deltas are
+            bitwise the vmapped ones (vmap per-row numerics are width-
+            independent; pinned in tests/test_fleet.py)."""
+            new = local_sgd(apply_fn, params, x, y, m, epochs=cfg.epochs,
+                            batch_size=cfg.batch_size, lr=cfg.lr, key=k)
+            delta = pt.tree_sub(params, new)
+            if clip is not None:
+                delta = clip_by_global_norm(delta, clip)
+            return delta
+
+        # The three cohort-step flavors. Each takes params as an argument
+        # (nothing dynamic in the closure), so one trace serves every
+        # round of every tier.
+        @jax.jit
+        def stream_step(params, acc, xs, ys, ms, keys, w):
+            """Plain streaming: vmap W local solves, fold the weighted
+            deltas into the carried aggregate (weight-0 rows are exact
+            no-ops — the padding contract)."""
+            deltas = jax.vmap(
+                lambda x, y, m, k: delta_client(params, x, y, m, k,
+                                                fleet.edge.dp_clip)
+            )(xs, ys, ms, keys)
+            return pt.tree_weighted_fold(deltas, w, init=acc)
+
+        @jax.jit
+        def collect_step(params, xs, ys, ms, keys):
+            """Defense mode: return the cohort's per-client FLAT deltas
+            [W, P] for host-side stacking (the tier defense needs the full
+            stack; memory note in the module docstring)."""
+            deltas = jax.vmap(
+                lambda x, y, m, k: delta_client(params, x, y, m, k,
+                                                fleet.edge.dp_clip)
+            )(xs, ys, ms, keys)
+            flat, _ = stack_flat(deltas)
+            return flat
+
+        @jax.jit
+        def secagg_step(params, xs, ys, ms, keys, gids, pair_ids,
+                        pair_valid, mask_root, r, active):
+            """Secure-agg mode: each ACTIVE client's pairwise-masked int32
+            upload (fl/secure_agg.masked_upload — the same ops as the
+            vmapped server's clients), summed over the cohort. Padded rows
+            contribute exact zeros; the int32 ring sum is order-free, so
+            the host's wrapped accumulation across cohorts equals the
+            vmapped single sum bitwise."""
+            clip_norm, bits = fleet.edge.secure_agg
+            scale = secagg_scale(clip_norm, bits)
+
+            def client(x, y, m, k, gid, act):
+                q = masked_upload(apply_fn, cfg, params, x, y, m, k, gid,
+                                  pair_ids, pair_valid, mask_root, r,
+                                  clip_norm, scale)
+                return jax.tree.map(lambda l: jnp.where(act, l, 0), q)
+
+            ups = jax.vmap(client)(xs, ys, ms, keys, gids, active)
+            return jax.tree.map(lambda u: u.sum(0), ups)
+
+        self._stream_step = stream_step
+        self._collect_step = collect_step
+        self._secagg_step = secagg_step
+
+    # ------------------------------------------------------------- plumbing
+    def _edge_width(self, e: int) -> int:
+        """Size of edge ``e``'s client partition (np.array_split shape)."""
+        m = self.cfg.clients_per_round
+        return len(np.array_split(np.arange(m), self.fleet.edges)[e])
+
+    def _weighting_counts(self, counts: np.ndarray) -> np.ndarray:
+        if self.fleet.weighting == "uniform":
+            return np.ones(len(counts), np.int32)
+        return counts
+
+    def _noise_key(self, r: int, tier: int, e: int):
+        k = jax.random.key(self.cfg.seed ^ _FLEET_NOISE_SALT)
+        k = jax.random.fold_in(k, r)
+        k = jax.random.fold_in(k, tier)
+        return jax.random.fold_in(k, e)
+
+    def _emit_cohort(self, r: int, tier: str, e: int, c: int,
+                     n_real: int) -> None:
+        if self.telemetry is not None:
+            self.telemetry.events.fl_cohort(
+                round=r, tier=tier, cohort=c, edge=e, clients=n_real,
+                payload_bytes=n_real * self._client_payload_bytes)
+
+    # ----------------------------------------------------------- edge tier
+    def _stream_edge(self, params, r: int, e: int, eidx: np.ndarray,
+                     weights: np.ndarray) -> PyTree:
+        """One edge's round in plain streaming mode: O(W) device clients
+        at a time, sequential fold into the carried aggregate."""
+        W = self.fleet.cohort_width
+        acc = pt.tree_zeros_like(params)
+        for c in range(-(-len(eidx) // W)):
+            cidx = eidx[c * W:(c + 1) * W]
+            cw = weights[c * W:(c + 1) * W]
+            n_real = len(cidx)
+            if n_real < W:     # pad: duplicate a real client at weight 0
+                cidx = np.concatenate(
+                    [cidx, np.full(W - n_real, cidx[0], cidx.dtype)])
+                cw = np.concatenate(
+                    [cw, np.zeros(W - n_real, np.float32)])
+            xs, ys, ms = self.source.cohort(cidx)
+            keys = jax.vmap(jax.random.key)(
+                jnp.asarray(self.client_seeds(r, cidx)))
+            acc = self._stream_step(params, acc, jnp.asarray(xs),
+                                    jnp.asarray(ys), jnp.asarray(ms),
+                                    keys, jnp.asarray(cw))
+            self._emit_cohort(r, "edge", e, c, n_real)
+        return acc
+
+    def _collect_edge(self, params, r: int, e: int, eidx: np.ndarray
+                      ) -> np.ndarray:
+        """One edge's round in defense mode: stream cohorts, collect the
+        per-client flat deltas [m_e, P] on the host."""
+        W = self.fleet.cohort_width
+        rows: List[np.ndarray] = []
+        for c in range(-(-len(eidx) // W)):
+            cidx = eidx[c * W:(c + 1) * W]
+            n_real = len(cidx)
+            if n_real < W:
+                cidx = np.concatenate(
+                    [cidx, np.full(W - n_real, cidx[0], cidx.dtype)])
+            xs, ys, ms = self.source.cohort(cidx)
+            keys = jax.vmap(jax.random.key)(
+                jnp.asarray(self.client_seeds(r, cidx)))
+            flat = self._collect_step(params, jnp.asarray(xs),
+                                      jnp.asarray(ys), jnp.asarray(ms),
+                                      keys)
+            rows.append(np.asarray(flat)[:n_real])
+            self._emit_cohort(r, "edge", e, c, n_real)
+        return np.concatenate(rows, axis=0)
+
+    def _secagg_edge(self, params, r: int, e: int, eidx: np.ndarray
+                     ) -> PyTree:
+        """One edge's round under pairwise masking: the host only ever
+        observes masked int32 sums; wrapping np.int32 accumulation across
+        cohorts is exact on the mod-2^32 ring."""
+        W = self.fleet.cohort_width
+        m_e = len(eidx)
+        # Fixed-width pair set: every edge pads its id list to the widest
+        # edge's length so the compiled step's scan length is static.
+        pair_w = self._edge_width(0)
+        pair_ids = np.concatenate(
+            [eidx, np.zeros(pair_w - m_e, eidx.dtype)])
+        pair_valid = np.arange(pair_w) < m_e
+        mask_root = jax.random.key(self.cfg.seed ^ _MASK_SALT)
+        total = None
+        for c in range(-(-m_e // W)):
+            cidx = eidx[c * W:(c + 1) * W]
+            n_real = len(cidx)
+            active = np.arange(W) < n_real
+            if n_real < W:
+                cidx = np.concatenate(
+                    [cidx, np.full(W - n_real, cidx[0], cidx.dtype)])
+            xs, ys, ms = self.source.cohort(cidx)
+            keys = jax.vmap(jax.random.key)(
+                jnp.asarray(self.client_seeds(r, cidx)))
+            part = self._secagg_step(
+                params, jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ms),
+                keys, jnp.asarray(cidx), jnp.asarray(pair_ids),
+                jnp.asarray(pair_valid), mask_root, jnp.int32(r),
+                jnp.asarray(active))
+            part = jax.tree.map(np.asarray, part)
+            total = part if total is None else jax.tree.map(
+                np.add, total, part)          # int32: wraps mod 2^32
+            self._emit_cohort(r, "edge", e, c, n_real)
+        # Dequantize the cancelled sum and average uniformly — the same
+        # single multiply by the host constant scale/m as
+        # SecureAggFedAvgServer's server side, so edges=1 matches it
+        # bitwise (the int32 ring sum already does, order-free).
+        return dequantize_tree(jax.tree.map(jnp.asarray, total),
+                               self._secagg_scale / m_e)
+
+    def _edge_round(self, params, r: int, e: int, eidx: np.ndarray,
+                    counts: np.ndarray) -> PyTree:
+        """One edge aggregate: stream, then apply the edge TierPolicy."""
+        pol = self.fleet.edge
+        if pol.secure_agg is not None:
+            return self._secagg_edge(params, r, e, eidx)
+        w = np.asarray(_round_weights(
+            jnp.asarray(self._weighting_counts(counts))))
+        if self._collect:
+            flat = self._collect_edge(params, r, e, eidx)
+            flat_hook = getattr(pol.defense, "flat_hook", None)
+            if flat_hook is not None:
+                # The adapter's flat core consumes the collected [m_e, P]
+                # stack directly — no stacked-pytree round trip. Same ops
+                # as the pytree entry point, so the bitwise parity with
+                # FedAvgGradServer(defense=...) is unchanged.
+                agg = self._unflatten_vec(
+                    flat_hook(jnp.asarray(flat), jnp.asarray(w)))
+            else:
+                stacked = unstack_flat(jnp.asarray(flat), params)
+                agg = pol.defense(stacked, jnp.asarray(w))
+        else:
+            agg = self._stream_edge(params, r, e, eidx, w)
+        if pol.dp_noise_multiplier > 0:
+            sigma = pol.dp_noise_multiplier * pol.dp_clip / len(eidx)
+            agg = pt.tree_add(agg, gaussian_noise_like(
+                self._noise_key(r, 0, e), agg, sigma))
+        return agg
+
+    # ---------------------------------------------------------- server tier
+    def _server_round(self, r: int, edge_aggs: List[PyTree],
+                      edge_counts: np.ndarray) -> PyTree:
+        """Reduce the E edge aggregates per the server TierPolicy. Skipped
+        entirely in the flat case (E=1, empty policy) so the flat path is
+        bitwise the single edge's fold."""
+        pol = self.fleet.server
+        if (len(edge_aggs) == 1 and pol.defense is None
+                and pol.dp_clip is None and pol.dp_noise_multiplier == 0):
+            return edge_aggs[0]
+        stacked = pt.tree_stack(edge_aggs)
+        if pol.dp_clip is not None:
+            stacked = jax.vmap(
+                lambda t: clip_by_global_norm(t, pol.dp_clip))(stacked)
+        ew = _round_weights(jnp.asarray(
+            self._weighting_counts(edge_counts)))
+        if pol.defense is not None:
+            agg = pol.defense(stacked, ew)
+        else:
+            agg = pt.tree_weighted_fold(stacked, ew)
+        if pol.dp_noise_multiplier > 0:
+            sigma = (pol.dp_noise_multiplier * pol.dp_clip
+                     / len(edge_aggs))
+            agg = pt.tree_add(agg, gaussian_noise_like(
+                self._noise_key(r, 1, 0), agg, sigma))
+        return agg
+
+    # ------------------------------------------------------------ the round
+    def _round(self, params, r):
+        idx = self._sample(r)
+        m = len(idx)
+        counts = np.asarray(self.source.counts(idx))
+        parts = np.array_split(np.arange(m), self.fleet.edges)
+        edge_aggs: List[PyTree] = []
+        edge_counts = np.empty(len(parts), np.int64)
+        for e, pos in enumerate(parts):
+            edge_aggs.append(
+                self._edge_round(params, r, e, idx[pos], counts[pos]))
+            edge_counts[e] = (int(counts[pos].sum())
+                              if self.fleet.weighting == "samples"
+                              else len(pos))
+        tel = self.telemetry
+        if tel is not None:
+            tel.events.fl_tier(
+                round=r, tier="edge", edges=len(parts), clients=m,
+                payload_bytes=m * self._client_payload_bytes,
+                wire=("int32-masked"
+                      if self.fleet.edge.secure_agg is not None
+                      else "float32"))
+            tel.events.fl_tier(
+                round=r, tier="server", inputs=len(edge_aggs),
+                payload_bytes=(len(edge_aggs)
+                               * self._client_payload_bytes))
+        agg = self._server_round(r, edge_aggs, edge_counts)
+        return pt.tree_sub(params, agg)
+
+
+# ------------------------------------------------------------ the reference
+
+def vmapped_round_reference(params, apply_fn, source, idx, cfg: FLConfig,
+                            r: int, *, weighting: str = "samples",
+                            clip: Optional[float] = None) -> PyTree:
+    """The O(clients)-device-memory path the streamed engine must match
+    bitwise at equal cohort content: every sampled client vmapped resident
+    at once, aggregated with the same sequential fold. Used by
+    tests/test_fleet.py and the fleet smoke's control slice — it is the
+    executable statement of 'what the round means', with the fleet engine
+    as the scalable implementation of it."""
+    idx = np.asarray(idx)
+    xs, ys, ms = source.cohort(idx)
+    m = cfg.clients_per_round
+    seeds = [rngmod.per_client_seed(cfg.seed, r, int(i), m) for i in idx]
+    keys = jax.vmap(jax.random.key)(jnp.asarray(seeds))
+
+    def client(x, y, mk, k):
+        new = local_sgd(apply_fn, params, x, y, mk, epochs=cfg.epochs,
+                        batch_size=cfg.batch_size, lr=cfg.lr, key=k)
+        delta = pt.tree_sub(params, new)
+        if clip is not None:
+            delta = clip_by_global_norm(delta, clip)
+        return delta
+
+    deltas = jax.vmap(client)(jnp.asarray(xs), jnp.asarray(ys),
+                              jnp.asarray(ms), keys)
+    counts = (np.ones(len(idx), np.int32) if weighting == "uniform"
+              else np.asarray(source.counts(idx)))
+    w = _round_weights(jnp.asarray(counts))
+    return pt.tree_sub(params, pt.tree_weighted_fold(deltas, w))

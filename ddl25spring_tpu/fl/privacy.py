@@ -146,6 +146,29 @@ def dp_epsilon_tight(noise_multiplier: float, rounds: int,
     return max(0.0, best)
 
 
+def privacy_spend(noise_multiplier: float, rounds: int, sampling_rate: float,
+                  delta: float = 1e-6) -> dict:
+    """Both ε bounds for one (z, T, q, δ) protocol point, as a JSON-able
+    record — the fleet smoke (experiments/fleet_smoke.py) reports this at
+    realistic fleet sampling rates (q ~ 1e-4, where a cohort of thousands
+    samples from millions of installs) so the privacy cost of a deployment
+    shape is a number in CI artifacts, not a claim. ``eps_rdp_tight`` is
+    the subsampled-Gaussian RDP accountant (the certifiable figure);
+    ``eps_advanced_composition`` the conservative no-amplification bound —
+    at fleet q the gap is orders of magnitude, which is exactly why the
+    tight accountant matters at scale."""
+    return {
+        "sampling_rate_q": float(sampling_rate),
+        "noise_multiplier": float(noise_multiplier),
+        "rounds": int(rounds),
+        "delta": float(delta),
+        "eps_rdp_tight": dp_epsilon_tight(noise_multiplier, rounds,
+                                          sampling_rate, delta),
+        "eps_advanced_composition": dp_epsilon(noise_multiplier, rounds,
+                                               delta),
+    }
+
+
 class DPFedAvgServer(_ServerBase):
     """FedAvg with per-client delta clipping + server-side Gaussian noise.
 

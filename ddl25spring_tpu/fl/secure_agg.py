@@ -79,6 +79,74 @@ def mask_tree(key, tree):
     return jax.tree.unflatten(treedef, masks)
 
 
+def secagg_scale(clip_norm: float, bits: int) -> float:
+    """The shared fixed-point grid step: ``clip_norm / 2^(bits-1)`` — a
+    config constant, never data-dependent (module docstring step 2)."""
+    return float(clip_norm) / float(2 ** (bits - 1))
+
+
+def check_secagg_capacity(bits: int, m_clients: int) -> None:
+    """Raise unless m clipped uploads fit int32 without wrapping the TRUE
+    (post-cancellation) sum: a clipped delta can put a whole coordinate at
+    clip_norm = 2^(bits-1) grid steps, so m clients can sum to
+    m·2^(bits-1); past 2^31 that wraps and dequantizes with flipped sign,
+    silently corrupting the round."""
+    if not 2 <= bits <= 30:
+        raise ValueError(f"bits={bits} outside [2, 30]")
+    if m_clients >= 2 ** (31 - (bits - 1)):
+        raise ValueError(
+            f"bits={bits} overflows int32 at m={m_clients} sampled "
+            f"clients: need m < 2^{31 - (bits - 1)}; lower bits or the "
+            "cohort size")
+
+
+def masked_upload(apply_fn, cfg, params, x, y, m, key, my_gid, pair_ids,
+                  pair_valid, mask_root, r, clip: float, scale: float):
+    """One client's view of the protocol: local_sgd → clip → quantize →
+    add the pairwise masks vs every valid id in ``pair_ids``. Returns the
+    masked int32 tree the server observes.
+
+    ``pair_ids``/``pair_valid`` let a FIXED-width pair array serve any
+    actual pair set (invalid entries contribute sign 0 — exactly nothing
+    in int arithmetic), so the fleet engine's cohort step compiles once
+    while streaming edges of any size. ONE implementation on purpose: the
+    vmapped server round and the cohort-streamed fleet round
+    (fl/fleet.py) are bitwise comparable only because both clients run
+    exactly these ops."""
+    new = local_sgd(apply_fn, params, x, y, m, epochs=cfg.epochs,
+                    batch_size=cfg.batch_size, lr=cfg.lr, key=key)
+    delta = clip_by_global_norm(pt.tree_sub(params, new), clip)
+    q = quantize_tree(delta, scale)
+
+    # Pairwise masks vs every OTHER client in the pair set: +mask when my
+    # global id is the smaller of the pair, − otherwise — the two roles
+    # derive the same key, so the sum cancels.
+    def add_pair(q_acc, pair):
+        other_gid, valid = pair
+        k = _pair_key(mask_root, my_gid, other_gid, r)
+        mask = mask_tree(k, q_acc)
+        sign = jnp.where(valid,
+                         jnp.where(other_gid == my_gid, 0,
+                                   jnp.where(my_gid < other_gid, 1, -1)),
+                         0).astype(jnp.int32)
+        return jax.tree.map(lambda a, mm: a + sign * mm,
+                            q_acc, mask), None
+
+    q_masked, _ = jax.lax.scan(add_pair, q, (pair_ids, pair_valid))
+    return q_masked
+
+
+def finish_secagg_round(params, q_sum, scale: float, m_clients: int):
+    """The server's unmasking tail, OUTSIDE jit on purpose: dequantize the
+    cancelled ring sum with the single host constant ``scale/m`` (one
+    multiply — two would leave the rounding to constant-folding luck) and
+    apply the averaged delta. Shared by the vmapped server and the fleet
+    engine so the tail's float roundings are literally the same ops — an
+    in-jit tail is at the mercy of XLA fusing ``p − q·c`` into an FMA,
+    which is a 1-ulp difference the bitwise parity bar would see."""
+    return pt.tree_sub(params, dequantize_tree(q_sum, scale / m_clients))
+
+
 class SecureAggFedAvgServer(_ServerBase):
     """FedAvg where the server only observes pairwise-masked fixed-point
     uploads (see module docstring). ``bits`` sets the quantization grid
@@ -93,57 +161,28 @@ class SecureAggFedAvgServer(_ServerBase):
     def __init__(self, *args, clip_norm: float = 5.0, bits: int = 20,
                  **kw):
         super().__init__(*args, algorithm="secagg-fedavg", **kw)
-        if not 2 <= bits <= 30:
-            raise ValueError(f"bits={bits} outside [2, 30]")
-        # The TRUE (post-cancellation) sum must fit int32: a clipped delta
-        # can put a whole coordinate at clip_norm = 2^(bits-1) grid steps,
-        # so m clients can sum to m·2^(bits-1); past 2^31 that wraps and
-        # dequantizes with flipped sign, silently corrupting the round.
-        if self.cfg.clients_per_round >= 2 ** (31 - (bits - 1)):
-            raise ValueError(
-                f"bits={bits} overflows int32 at m="
-                f"{self.cfg.clients_per_round} sampled clients: need "
-                f"m < 2^{31 - (bits - 1)}; lower bits or the cohort size")
+        check_secagg_capacity(bits, self.cfg.clients_per_round)
         self.clip_norm = float(clip_norm)
         self.bits = bits
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
-        scale = self.clip_norm / float(2 ** (bits - 1))
+        scale = self._scale = secagg_scale(self.clip_norm, bits)
         clip = self.clip_norm
 
         @jax.jit
         def round_step(params, idx, keys, mask_root, r):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
-            m_clients = idx.shape[0]
+            pair_valid = jnp.ones(idx.shape[0], bool)
 
             def client(x, y, m, key, my_gid):
-                new = local_sgd(apply_fn, params, x, y, m, epochs=cfg.epochs,
-                                batch_size=cfg.batch_size, lr=cfg.lr, key=key)
-                delta = clip_by_global_norm(pt.tree_sub(params, new), clip)
-                q = quantize_tree(delta, scale)
-
-                # Pairwise masks vs every OTHER sampled client: +mask when
-                # my global id is the smaller of the pair, − otherwise —
-                # the two roles derive the same key, so the sum cancels.
-                def add_pair(q_acc, other_gid):
-                    k = _pair_key(mask_root, my_gid, other_gid, r)
-                    mask = mask_tree(k, q_acc)
-                    sign = jnp.where(other_gid == my_gid, 0,
-                                     jnp.where(my_gid < other_gid, 1, -1)
-                                     ).astype(jnp.int32)
-                    return jax.tree.map(lambda a, mm: a + sign * mm,
-                                        q_acc, mask), None
-
-                q_masked, _ = jax.lax.scan(add_pair, q, idx)
-                return q_masked
+                return masked_upload(apply_fn, cfg, params, x, y, m, key,
+                                     my_gid, idx, pair_valid, mask_root, r,
+                                     clip, scale)
 
             uploads = jax.vmap(client, in_axes=(0, 0, 0, 0, 0))(
                 xs, ys, ms, keys, idx)
             # The server's view: only masked uploads. Wrapping int32 sum —
             # the pairwise masks cancel exactly mod 2^32.
-            q_sum = jax.tree.map(lambda u: u.sum(0), uploads)
-            agg = pt.tree_scale(dequantize_tree(q_sum, scale),
-                                1.0 / m_clients)
-            return pt.tree_sub(params, agg)
+            return jax.tree.map(lambda u: u.sum(0), uploads)
 
         self._round_step = round_step
 
@@ -152,5 +191,7 @@ class SecureAggFedAvgServer(_ServerBase):
         keys = jax.vmap(jax.random.key)(
             jnp.asarray(self.client_seeds(r, idx)))
         mask_root = jax.random.key(self.cfg.seed ^ _MASK_SALT)
-        return self._round_step(params, jnp.asarray(idx), keys, mask_root,
-                                jnp.int32(r))
+        q_sum = self._round_step(params, jnp.asarray(idx), keys, mask_root,
+                                 jnp.int32(r))
+        return finish_secagg_round(params, q_sum, self._scale,
+                                   len(idx))

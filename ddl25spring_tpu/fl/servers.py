@@ -24,11 +24,21 @@ The aggregation point is an explicit hook (``defense=``): selection defenses
 (median family) replace the weighted mean entirely — mirroring the
 FedAvgServerDefense / FedAvgServerDefenseCoordinate split (cells 34, 43).
 
+Aggregation discipline: the weighted average is a SEQUENTIAL fold
+(utils.pytree.tree_weighted_fold) with the weights computed by ONE shared
+compiled helper (``_round_weights``) and passed into the round step. Both
+choices are load-bearing: the fold's fixed association makes zero-weight
+rows exact no-ops (so faulted rounds can pad instead of retracing, below)
+and makes the cohort-streaming fleet engine (fl/fleet.py) bitwise-equal
+to these vmapped servers at equal cohort content.
+
 Benign faults (resilience layer): every server accepts ``fault_plan=`` — a
 resilience.FaultPlan scheduling client dropout/straggling per round. The
 round then aggregates over the survivors with renormalized sample-count
 weights (an all-clients-lost round is skipped, params unchanged), and the
-drop/straggle/skip counters land in ``server.resilience``. This is the
+drop/straggle/skip counters land in ``server.resilience``. Survivor sets
+are padded back to the full sampled width with zero-weight duplicates, so
+every survivor count reuses the one compiled round step. This is the
 paper's Byzantine story (§6) extended to the *infrastructure* fault class:
 a vanished client is handled by the same aggregation point as a malicious
 one, but by re-weighting instead of by defense.
@@ -55,11 +65,25 @@ from .local import full_batch_grad, local_sgd, masked_mean_loss
 PyTree = Any
 
 
-def _weights_for(counts: jnp.ndarray) -> jnp.ndarray:
+def _weights_for(counts: jnp.ndarray,
+                 wmask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Sample-count FedAvg weights over the sampled clients
-    (hfl_complete.py:366-368)."""
+    (hfl_complete.py:366-368). ``wmask`` (0/1 per client) zeroes padded or
+    dropped entries while keeping the array shape — the compiled round step
+    then serves every survivor count at one trace."""
     c = counts.astype(jnp.float32)
+    if wmask is not None:
+        c = c * wmask
     return c / jnp.maximum(c.sum(), 1.0)
+
+
+# ONE standalone compiled weight computation, shared by every server's
+# ``_round`` and by the fleet engine (fl/fleet.py): weights computed here
+# and passed INTO the round step are bitwise identical across the vmapped
+# and cohort-streamed paths — computing them inside each round step would
+# leave the reduction over ``counts`` at the mercy of how XLA fuses that
+# particular program.
+_round_weights = jax.jit(_weights_for)
 
 
 class _ServerBase:
@@ -113,23 +137,32 @@ class _ServerBase:
         self.result.record_round(
             wall, message_count(round_idx, self.cfg.clients_per_round), self.test())
 
+    # Faulted rounds pad the survivor set back to the full sampled width
+    # (duplicating a survivor at weight 0), so every survivor count reuses
+    # the ONE compiled round step. Selection defenses inspect per-client
+    # geometry (a duplicated client would have pairwise distance 0 and skew
+    # Krum's scores), so FedAvgGradServer opts out when a defense is set
+    # and falls back to filtering (one retrace per distinct count).
+    _pad_dropout = True
+
     def _round(self, params, r):
         idx = self._sample(r)
+        wmask = None
         if self.fault_plan is not None:
             # Benign faults: scheduled clients vanish (dropped) or miss the
             # round deadline (stragglers). The round re-weights aggregation
-            # over the survivors by filtering ``idx`` on the host — the
-            # sample-count weights renormalize over whoever is left, and
-            # every defense hook sees only updates that actually arrived.
-            # Deterministic under the plan's seed; and because client seeds
-            # use the GLOBAL client index (hfl_complete.py:364), a
-            # survivor's local randomness is identical whether or not its
-            # peers dropped — the surviving contributions are bit-identical
-            # to the fault-free round's. Known cost: each distinct survivor
-            # count is a new len(idx), so the vmapped round step retraces
-            # once per count — acceptable for rare faulted rounds; padding
-            # idx with zero weights would hold one shape if chaos runs with
-            # per-round-varying dropout ever dominate.
+            # over the survivors — the sample-count weights renormalize over
+            # whoever is left, and every defense hook sees only updates that
+            # actually arrived. Deterministic under the plan's seed; and
+            # because client seeds use the GLOBAL client index
+            # (hfl_complete.py:364), a survivor's local randomness is
+            # identical whether or not its peers dropped — the surviving
+            # contributions are bit-identical to the fault-free round's.
+            # With ``_pad_dropout`` the dropped entries stay in the array as
+            # zero-weight duplicates of a survivor: tree_weighted_fold
+            # selects around weight-0 rows exactly, so the padded round is
+            # BITWISE the filtered one (pinned in tests/test_resilience.py)
+            # while holding one compiled shape across survivor counts.
             mask, dropped, stragglers = \
                 self.fault_plan.surviving_clients(r, idx)
             self.resilience.dropped_clients += dropped
@@ -139,12 +172,19 @@ class _ServerBase:
                 # unchanged) rather than dividing by zero arrivals.
                 self.resilience.skipped_rounds += 1
                 return params
-            idx = idx[mask]
+            if not mask.all():
+                if self._pad_dropout:
+                    idx = np.where(mask, idx, idx[mask][0])
+                    wmask = jnp.asarray(mask, jnp.float32)
+                else:
+                    idx = idx[mask]
         # Per-(client, round) PRNG keys from the reference seed formula:
         # dropout inside local training (the reference trains in train mode,
         # hfl_complete.py:72,271,351) and any data poisoning fold from these.
         keys = jax.vmap(jax.random.key)(jnp.asarray(self.client_seeds(r, idx)))
-        return self._round_step(params, jnp.asarray(idx), keys)
+        idx = jnp.asarray(idx)
+        w = _round_weights(self.data.sample_counts[idx], wmask)
+        return self._round_step(params, idx, keys, w)
 
     def run(self, nr_rounds: Optional[int] = None) -> RunResult:
         nr_rounds = self.cfg.rounds if nr_rounds is None else nr_rounds
@@ -155,7 +195,8 @@ class _ServerBase:
                 trainer=f"fl/{self.result.algorithm}",
                 jax_version=jax.__version__,
                 platform=jax.devices()[0].platform,
-                fl_cfg=dataclasses.asdict(self.cfg), rounds=nr_rounds)
+                fl_cfg=dataclasses.asdict(self.cfg), rounds=nr_rounds,
+                **getattr(self, "_manifest_extra", {}))
             prev_counters = self.resilience.as_dict()
         for r in range(nr_rounds):
             t0 = time.perf_counter()
@@ -191,12 +232,11 @@ class FedSgdGradientServer(_ServerBase):
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
 
         @jax.jit
-        def round_step(params, idx, keys):
+        def round_step(params, idx, keys, w):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
             _, grads = jax.vmap(lambda x, y, m, k: full_batch_grad(
                 apply_fn, params, x, y, m, k))(xs, ys, ms, keys)
-            w = _weights_for(data.sample_counts[idx])
-            agg = pt.tree_weighted_sum(grads, w)
+            agg = pt.tree_weighted_fold(grads, w)
             return jax.tree.map(lambda p, g: p - cfg.lr * g, params, agg)
 
         self._round_step = round_step
@@ -211,7 +251,7 @@ class FedSgdWeightServer(_ServerBase):
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
 
         @jax.jit
-        def round_step(params, idx, keys):
+        def round_step(params, idx, keys, w):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
 
             def client(x, y, m, k):
@@ -219,8 +259,7 @@ class FedSgdWeightServer(_ServerBase):
                 return jax.tree.map(lambda p, gi: p - cfg.lr * gi, params, g)
 
             new_weights = jax.vmap(client)(xs, ys, ms, keys)
-            w = _weights_for(data.sample_counts[idx])
-            return pt.tree_weighted_sum(new_weights, w)
+            return pt.tree_weighted_fold(new_weights, w)
 
         self._round_step = round_step
 
@@ -241,12 +280,11 @@ class FedAvgServer(_ServerBase):
         solver = self._local_solver()
 
         @jax.jit
-        def round_step(params, idx, keys):
+        def round_step(params, idx, keys, w):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
             new_weights = jax.vmap(
                 lambda x, y, m, k: solver(params, x, y, m, k))(xs, ys, ms, keys)
-            w = _weights_for(data.sample_counts[idx])
-            return pt.tree_weighted_sum(new_weights, w)
+            return pt.tree_weighted_fold(new_weights, w)
 
         self._round_step = round_step
 
@@ -271,12 +309,16 @@ class FedAvgGradServer(_ServerBase):
         super().__init__(*args, algorithm="fedavg-grad", **kw)
         self.adversary = adversary
         self.defense = defense
+        # Selection defenses score per-client geometry; a zero-weight
+        # padded duplicate would sit at distance 0 from its twin and skew
+        # Krum-family scores, so defended servers keep the filtering path.
+        self._pad_dropout = defense is None
         data, cfg, apply_fn = self.data, self.cfg, self.apply_fn
         attack = adversary[1] if adversary is not None else None
         malicious_mask = jnp.asarray(adversary[0]) if adversary is not None else None
 
         @jax.jit
-        def round_step(params, idx, keys):
+        def round_step(params, idx, keys, w):
             xs, ys, ms = data.x[idx], data.y[idx], data.mask[idx]
 
             def client(x, y, m, key, is_mal):
@@ -303,9 +345,8 @@ class FedAvgGradServer(_ServerBase):
             is_mal = (malicious_mask[idx] if malicious_mask is not None
                       else jnp.zeros(idx.shape, bool))
             deltas = jax.vmap(client)(xs, ys, ms, keys, is_mal)
-            w = _weights_for(data.sample_counts[idx])
             if defense is None:
-                agg = pt.tree_weighted_sum(deltas, w)
+                agg = pt.tree_weighted_fold(deltas, w)
             else:
                 agg = defense(deltas, w)
             return pt.tree_sub(params, agg)

@@ -439,6 +439,17 @@ class Engine:
             events.append(TokenEvent(int(s), tok, first=False, done=done))
         return events
 
+    def retire(self, s: int) -> None:
+        """Retire slot ``s`` early, before its ``max_new`` horizon — the
+        scheduler's EOS path. The slot's WHOLE reservation (written blocks
+        and the never-to-be-written worst-case tail alike) returns to the
+        pool at this token boundary. Safe at any phase: the freed blocks'
+        stale K/V is unreachable once the table row resets to trash, and
+        a future owner overwrites before it reads (position masking)."""
+        if self.slots[s] is None:
+            raise ValueError(f"retire({s}): slot is not active")
+        self._retire(s)
+
     def _retire(self, s: int) -> None:
         """Free the slot and its blocks IMMEDIATELY (the continuous-batching
         point: the next token boundary can re-use them)."""

@@ -98,8 +98,15 @@ def reference_stream(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
     if req.temperature > 0:
         kw.update(key=jax.random.PRNGKey(req.seed),
                   temperature=req.temperature)
-    return generate.generate(params, jnp.asarray(req.prompt)[None], cfg,
+    toks = generate.generate(params, jnp.asarray(req.prompt)[None], cfg,
                              req.max_new, **kw)[0].tolist()
+    if req.eos_id is not None and req.eos_id in toks:
+        # generate() has no early stop (one compiled scan to the max_new
+        # horizon); a request with an EOS id is served its stream
+        # truncated at the first EOS INCLUSIVE — the scheduler retires the
+        # slot at that boundary, so nothing after it was ever emitted.
+        toks = toks[:toks.index(req.eos_id) + 1]
+    return toks
 
 
 class _Clock:

@@ -54,13 +54,18 @@ class Request:
     """One generation request. ``seed`` feeds ``jax.random.PRNGKey`` when
     ``temperature > 0`` (equal seed ⇒ the stream ``generate()`` would emit
     alone). ``arrival`` is an offset in seconds from workload start — the
-    load harness's Poisson schedule, ignored by direct submitters."""
+    load harness's Poisson schedule, ignored by direct submitters.
+    ``eos_id``: emitting this token retires the request at that token
+    boundary, returning ALL its worst-case-reserved blocks immediately
+    (the stream up to and including the EOS is still bitwise
+    ``generate()``'s, which has no early stop — see ``Scheduler.tick``)."""
     rid: str
     prompt: Tuple[int, ...]
     max_new: int
     temperature: float = 0.0
     seed: int = 0
     arrival: float = 0.0
+    eos_id: Optional[int] = None
 
 
 @dataclass
@@ -164,7 +169,17 @@ class Scheduler:
         emitted: List[Tuple[str, int]] = []
         events = self.engine.step()
         now = self.clock()   # post-step: token timestamps include the step
+        eos_retired: set = set()
         for ev in events:
+            if ev.slot in eos_retired:
+                # The slot EOS-retired earlier THIS tick (engine.step can
+                # emit a final prefill token and a same-boundary decode
+                # token for one slot): anything after the EOS is post-end
+                # and never existed semantically — drop it. Scoped to
+                # this tick's EOS retirements only, so an event for a
+                # slot the scheduler genuinely doesn't own still raises
+                # (a dropped-token bug must stay loud).
+                continue
             req = self._by_slot[ev.slot]
             rec = self.records[req.rid]
             rec.tokens.append(ev.token)
@@ -174,7 +189,21 @@ class Scheduler:
                 self.events.request_token(req=req.rid,
                                           i=len(rec.tokens) - 1,
                                           tok=ev.token, slot=ev.slot)
-            if ev.done:
+            done = ev.done
+            early_eos = False
+            if not done and req.eos_id is not None and ev.token == req.eos_id:
+                # EOS early retirement: the request is semantically
+                # finished at THIS token boundary, so its blocks — the
+                # whole worst-case reservation, including the tail it will
+                # now never write — go back to the pool immediately
+                # instead of idling until the max_new horizon. Purely a
+                # capacity decision: the emitted stream is generate()'s
+                # stream truncated at the first EOS (the engine never fed
+                # the EOS back, so nothing downstream of it ever existed).
+                self.engine.retire(ev.slot)
+                eos_retired.add(ev.slot)
+                done = early_eos = True
+            if done:
                 rec.done_t = now
                 del self._by_slot[ev.slot]
                 self.completed += 1
@@ -184,7 +213,8 @@ class Scheduler:
                         queue_wait_s=rec.queue_wait_s, ttft_s=rec.ttft_s,
                         tokens_per_sec=rec.tokens_per_sec,
                         blocks_freed=rec.blocks,
-                        blocks_in_use=self.engine.blocks_in_use())
+                        blocks_in_use=self.engine.blocks_in_use(),
+                        **({"eos": True} if early_eos else {}))
             emitted.append((req.rid, ev.token))
         return emitted
 

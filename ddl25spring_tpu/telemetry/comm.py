@@ -150,7 +150,12 @@ class CommProfile:
         return d
 
 
-def _tree_bytes(tree: Any) -> int:
+def tree_bytes(tree: Any) -> int:
+    """Exact byte count of a pytree's leaves (shape × dtype itemsize) —
+    the unit of every payload figure in this module. Public because the
+    FL fleet engine (fl/fleet.py) accounts its tier-crossing uploads with
+    the same rule the collective wrappers use, so 'payload bytes' means
+    one thing across the whole telemetry stream."""
     total = 0
     for leaf in jax.tree.leaves(tree):
         shape = getattr(leaf, "shape", ())
@@ -158,6 +163,9 @@ def _tree_bytes(tree: Any) -> int:
         itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
         total += int(math.prod(shape)) * itemsize
     return total
+
+
+_tree_bytes = tree_bytes          # internal alias (pre-v3 call sites)
 
 
 def _axis_size(axis_name: str) -> Optional[int]:

@@ -33,18 +33,20 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 # v2: serving request lifecycle (request_enqueue / request_prefill /
-# request_token / request_done — serving/scheduler.py). Version bumps are
-# additive: a v2 reader accepts v1 streams unchanged, and v1 readers
-# reject v2 (the "future schema" rule in validate_event) rather than
-# misread it.
-SCHEMA_VERSION = 2
+# request_token / request_done — serving/scheduler.py). v3: fleet-scale FL
+# (fl/fleet.py) — ``fl_cohort`` (one device dispatch of a streamed cohort)
+# and ``fl_tier`` (one aggregation tier's per-round summary with exact
+# payload-byte accounting). Version bumps are additive: a v3 reader
+# accepts v1/v2 streams unchanged, and older readers reject v3 (the
+# "future schema" rule in validate_event) rather than misread it.
+SCHEMA_VERSION = 3
 
 # Event types this schema version defines. Emitters may add new types
 # freely; ``validate_event`` checks base fields for ALL types and the
 # per-type required fields only for the known ones.
 EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
-               "request_done")
+               "request_done", "fl_cohort", "fl_tier")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -69,6 +71,16 @@ _REQUIRED: Dict[str, tuple] = {
     "request_prefill": ("req", "slot"),
     "request_token": ("req", "i"),
     "request_done": ("req", "tokens"),
+    # Fleet-scale FL (fl/fleet.py, schema v3). ``fl_cohort`` is one
+    # compiled cohort dispatch: which tier/edge ran it, how many REAL
+    # (non-padded) clients it carried, and their exact upload payload
+    # bytes. ``fl_tier`` closes one tier's round: inputs reduced (clients
+    # for the edge tier, edge aggregates for the server tier) and the
+    # exact wire bytes that crossed into the tier, summed from leaf
+    # shapes/dtypes (telemetry.comm.tree_bytes) — the accounting the
+    # hierarchical-topology comparisons in PAPERS.md need.
+    "fl_cohort": ("round", "tier", "cohort"),
+    "fl_tier": ("round", "tier"),
 }
 
 
@@ -215,6 +227,15 @@ class EventLog:
     def request_done(self, *, req: str, tokens: int,
                      **fields) -> Dict[str, Any]:
         return self.emit("request_done", req=req, tokens=tokens, **fields)
+
+    # Fleet-scale FL (schema v3; fl/fleet.py emits).
+    def fl_cohort(self, *, round: int, tier: str, cohort: int,
+                  **fields) -> Dict[str, Any]:
+        return self.emit("fl_cohort", round=round, tier=tier, cohort=cohort,
+                         **fields)
+
+    def fl_tier(self, *, round: int, tier: str, **fields) -> Dict[str, Any]:
+        return self.emit("fl_tier", round=round, tier=tier, **fields)
 
     def close(self) -> None:
         with self._lock:

@@ -50,6 +50,40 @@ def tree_weighted_sum(trees: PyTree, weights: jnp.ndarray) -> PyTree:
     return jax.tree.map(leaf, trees)
 
 
+def tree_weighted_fold(trees: PyTree, weights: jnp.ndarray,
+                       init: PyTree = None) -> PyTree:
+    """Sequential (index-order) weighted sum over the leading stacked axis:
+    a left fold ``acc += w_i · leaf_i`` via lax.scan, starting from ``init``
+    (zeros when omitted).
+
+    Same value as ``tree_weighted_sum`` up to float association — but the
+    fold's association is FIXED by the stream order, where XLA may
+    re-associate ``(x*w).sum(0)`` differently per axis length. Three exact
+    properties follow, which the FL aggregation discipline (fl/servers.py,
+    fl/fleet.py) is built on:
+
+    - a zero-weight row is an exact no-op (selected around, not added), so
+      padding a cohort/survivor set to a fixed compiled width is invisible;
+    - folding a stream of chunks, each starting from the previous chunk's
+      carry, is bitwise the one-shot fold — cohort streaming at ANY width
+      equals the all-clients-resident path;
+    - the result does not depend on how many padded rows ride along.
+    """
+    if init is None:
+        init = jax.tree.map(
+            lambda x: jnp.zeros(x.shape[1:], x.dtype), trees)
+
+    def step(acc, row):
+        tree_i, w_i = row
+        acc = jax.tree.map(
+            lambda a, x: jnp.where(w_i != 0, a + w_i.astype(a.dtype) * x, a),
+            acc, tree_i)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, init, (trees, weights))
+    return acc
+
+
 def tree_stack(trees) -> PyTree:
     """List of pytrees -> single pytree with leading stacked axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)

@@ -60,6 +60,8 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
     steps = by_type.get("step", [])
     faults = by_type.get("fault", [])
     rounds = by_type.get("fl_round", [])
+    cohorts = by_type.get("fl_cohort", [])
+    tiers = by_type.get("fl_tier", [])
     remeshes = by_type.get("remesh", [])
     req_enq = by_type.get("request_enqueue", [])
     req_pre = by_type.get("request_prefill", [])
@@ -162,6 +164,32 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
         if walls:
             print("round time: " + "  ".join(
                 f"p{q:g}={percentile(walls, q):.3f}s" for q in (50, 95, 99)))
+
+    if cohorts or tiers:
+        # Fleet-scale FL section (schema v3 fl_cohort / fl_tier events,
+        # fl/fleet.py): how the cohort-streaming rounds moved bytes
+        # through the edge/server tiers. Runs without fleet events skip
+        # this silently, same as the serving section.
+        _section("fl fleet (cohort streaming)")
+        if cohorts:
+            clients = [e["clients"] for e in cohorts
+                       if isinstance(e.get("clients"), int)]
+            print(f"cohort dispatches: {len(cohorts)}"
+                  + (f"   clients/cohort p50="
+                     f"{percentile(clients, 50):.0f} "
+                     f"max={max(clients)}" if clients else ""))
+        by_tier = {}
+        for e in tiers:
+            agg = by_tier.setdefault(e.get("tier", "?"),
+                                     {"rounds": 0, "bytes": 0, "inputs": 0})
+            agg["rounds"] += 1
+            if isinstance(e.get("payload_bytes"), (int, float)):
+                agg["bytes"] += e["payload_bytes"]
+            agg["inputs"] += (e.get("clients") or e.get("inputs") or 0)
+        for tier, agg in by_tier.items():
+            print(f"  tier {tier:8s} rounds {agg['rounds']:<4d} "
+                  f"inputs {agg['inputs']:<8d} "
+                  f"payload {_fmt_bytes(agg['bytes'])}")
 
     metrics = (run_end or {}).get("metrics") or {}
     phase = {k: v for k, v in metrics.get("gauges", {}).items()

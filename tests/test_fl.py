@@ -58,14 +58,18 @@ def test_fedavg_learns_and_records_metrics(small_fl_setup):
 
 def test_fedavg_delta_framing_matches_weight_framing(small_fl_setup):
     """attacks_and_defenses.ipynb cells 3-6: the Δ-upload reformulation is
-    identical to weight-upload FedAvg."""
+    identical to weight-upload FedAvg — up to float association: the
+    weight framing sums Σw_i·(p−Δ_i) (catastrophic cancellation against
+    the much larger p), the delta framing p−Σw_i·Δ_i. atol 1e-5 covers
+    the near-zero coordinates where a relative bound is meaningless (the
+    seed's atol=1e-6 failed on 5/18432 elements on this jaxlib)."""
     params, data, x, y, xt, yt, cfg = small_fl_setup
     a = FedAvgServer(params, mnist_cnn.apply, data, xt, yt, cfg)
     b = FedAvgGradServer(params, mnist_cnn.apply, data, xt, yt, cfg)
     a.run(2)
     b.run(2)
     for pa, pb in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
-        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), rtol=2e-4, atol=1e-5)
 
 
 def test_client_sampling_matches_reference_shape(small_fl_setup):

@@ -11,7 +11,7 @@ import math
 import pytest
 
 from ddl25spring_tpu.fl.privacy import (_RDP_ORDERS, _rdp_sgm, dp_epsilon,
-                                        dp_epsilon_tight)
+                                        dp_epsilon_tight, privacy_spend)
 
 
 def test_abadi_2016_published_value():
@@ -59,6 +59,20 @@ def test_limits_and_monotonicity():
     assert dp_epsilon_tight(1.0, 10, 0.1) < dp_epsilon_tight(1.0, 100, 0.1)
     assert dp_epsilon_tight(2.0, 100, 0.1) < dp_epsilon_tight(1.0, 100, 0.1)
     assert dp_epsilon_tight(1.0, 100, 0.05) < dp_epsilon_tight(1.0, 100, 0.2)
+
+
+def test_fleet_sampling_rate_epsilon_pinned():
+    """The fleet protocol point the smoke reports (ISSUE 7 satellite):
+    q=1e-4 (a 1k cohort from a 10M fleet), z=1, T=10k rounds, δ=1e-6.
+    The subsampled-RDP ε is pinned — and the conservative bound is ~4
+    orders of magnitude worse at this q, which is the whole argument for
+    carrying the tight accountant to fleet scale."""
+    spend = privacy_spend(1.0, 10_000, 1e-4, delta=1e-6)
+    assert spend["eps_rdp_tight"] == pytest.approx(0.5887, abs=0.01)
+    assert spend["eps_advanced_composition"] > 1000 * spend["eps_rdp_tight"]
+    # The record carries its own protocol point (artifact-auditable).
+    assert spend["sampling_rate_q"] == 1e-4
+    assert spend["rounds"] == 10_000
 
 
 def test_q_one_epsilon_sane_single_round():

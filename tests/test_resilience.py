@@ -569,8 +569,12 @@ def test_fl_all_clients_lost_round_is_skipped(fl_setup):
 def test_fl_survivor_reweighting_matches_direct_subset(fl_setup):
     """Re-weighted aggregation over survivors is EXACTLY the round the
     server would have run had it sampled only the survivors: the dropout
-    path adds no numerics of its own."""
+    path adds no numerics of its own. Since the padded-round refactor the
+    dropout path keeps the dropped entries as zero-weight duplicates —
+    tree_weighted_fold selects around weight-0 rows, so the padded round
+    still equals the filtered one bitwise."""
     from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.fl.servers import _round_weights
 
     params, apply_fn, data, xt, yt, cfg = fl_setup
     plan = FaultPlan.from_spec("drop_client@0:2", seed=3)
@@ -583,7 +587,25 @@ def test_fl_survivor_reweighting_matches_direct_subset(fl_setup):
     t = FedAvgServer(params, apply_fn, data, xt, yt, cfg)
     keys = jax.vmap(jax.random.key)(
         jnp.asarray(t.client_seeds(0, survivors)))
-    direct_params = t._round_step(t.params, jnp.asarray(survivors), keys)
+    survivors = jnp.asarray(survivors)
+    w = _round_weights(data.sample_counts[survivors], None)
+    direct_params = t._round_step(t.params, survivors, keys, w)
     for a, b in zip(jax.tree.leaves(dropped_params),
                     jax.tree.leaves(direct_params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fl_dropout_rounds_reuse_one_compiled_round_step(fl_setup):
+    """The satellite fix for the per-round retrace: rounds with DIFFERENT
+    survivor counts pad back to the full sampled width with zero-weight
+    masks, so the compiled round step serves every dropout pattern at ONE
+    trace (the old filtering path recompiled once per distinct count)."""
+    from ddl25spring_tpu.fl import FedAvgServer
+
+    params, apply_fn, data, xt, yt, cfg = fl_setup
+    # Distinct survivor counts in rounds 0/1/2: 2 dropped, 1, none.
+    plan = FaultPlan.from_spec("drop_client@0:2,drop_client@1:1", seed=5)
+    s = FedAvgServer(params, apply_fn, data, xt, yt, cfg, fault_plan=plan)
+    s.run(3)
+    assert s.resilience.dropped_clients == 3
+    assert s._round_step._cache_size() == 1

@@ -196,6 +196,78 @@ def test_pool_exhaustion_queues_never_deadlocks(params):
             params, CFG, tiny, req), req.rid
 
 
+def test_eos_early_retirement_frees_blocks_and_stays_bitwise(params):
+    """EOS-based early retirement: a request whose stream hits its eos_id
+    before max_new retires AT that token boundary, returning its whole
+    worst-case reservation immediately — peak pool occupancy drops on an
+    early-EOS workload — while every stream stays bitwise generate()'s
+    (truncated at the first EOS, inclusive)."""
+    prompt = tuple(range(2, 8))
+    max_new = 12
+    full = reference_stream(params, CFG, PAGED,
+                            Request(rid="probe", prompt=prompt,
+                                    max_new=max_new))
+    # Choose the EOS to be a token the greedy stream emits EARLY, so
+    # retirement provably beats the max_new horizon.
+    eos = full[1]
+    eos_cut = full[:full.index(eos) + 1]
+    assert len(eos_cut) < max_new
+
+    def drive(eos_id):
+        """Two identical requests, the second submitted mid-flight of the
+        first: without EOS both are resident together; with EOS the first
+        retires before the second admits."""
+        eng = Engine(params, CFG, PAGED, 2, prefill_chunk=8)
+        sched = Scheduler(eng)
+        need = eng.required_blocks(len(prompt), max_new)
+        sched.submit(Request(rid="a", prompt=prompt, max_new=max_new,
+                             eos_id=eos_id), now=0.0)
+        for tick in range(100):
+            if tick == 1:
+                # After a's first boundary: an EOS-retired a has already
+                # returned its blocks; a plain a still holds them for 11
+                # more tokens, so b's admission overlaps it.
+                sched.submit(Request(rid="b", prompt=prompt,
+                                     max_new=max_new, eos_id=eos_id),
+                             now=0.0)
+            if not sched.outstanding:
+                break
+            sched.tick()
+        assert sched.outstanding == 0
+        # The allocator's high-water mark is recorded AT allocation, so
+        # it sees intra-tick occupancy an after-tick sample would miss.
+        return sched, eng.allocator.peak_in_use, need
+
+    with_eos, peak_eos, need = drive(eos)
+    without, peak_plain, _ = drive(None)
+    # Streams: bitwise generate()'s, truncated at the first EOS.
+    for rid in ("a", "b"):
+        assert with_eos.records[rid].tokens == eos_cut, rid
+        assert without.records[rid].tokens == full, rid
+    # Capacity: the plain run held both reservations at once; early
+    # retirement returned a's blocks before b admitted.
+    assert peak_plain == 2 * need
+    assert peak_eos == need
+    # And the engine is fully drained either way.
+    assert with_eos.completed == 2 and without.completed == 2
+
+
+def test_eos_on_final_token_is_plain_retirement(params):
+    """An EOS landing exactly on the max_new-th token must not double-
+    retire (the engine already freed the slot)."""
+    prompt = tuple(range(3))
+    full = reference_stream(params, CFG, PAGED,
+                            Request(rid="p", prompt=prompt, max_new=4))
+    eng = Engine(params, CFG, PAGED, 1, prefill_chunk=4)
+    sched = Scheduler(eng)
+    sched.submit(Request(rid="r", prompt=prompt, max_new=4,
+                         eos_id=full[-1]), now=0.0)
+    while sched.outstanding:
+        sched.tick()
+    assert sched.records["r"].tokens == full
+    assert eng.allocator.in_use == 0 and sched.completed == 1
+
+
 def test_scheduler_rejects_unservable_request(params):
     eng = Engine(params, CFG, PAGED, 1)
     sched = Scheduler(eng)

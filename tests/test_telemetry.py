@@ -248,6 +248,41 @@ def test_validate_event_request_required_fields():
                            "it": 0}) == []
 
 
+def test_fleet_event_emitters_roundtrip(tmp_path):
+    """Schema v3: the fleet FL emitters (fl_cohort / fl_tier) produce
+    valid, strictly-readable events carrying their required fields."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="fleet") as log:
+        log.fl_cohort(round=0, tier="edge", cohort=3, edge=1, clients=64,
+                      payload_bytes=64 * 1320)
+        log.fl_tier(round=0, tier="edge", edges=4, clients=256,
+                    payload_bytes=256 * 1320, wire="float32")
+        log.fl_tier(round=0, tier="server", inputs=4,
+                    payload_bytes=4 * 1320)
+    events = read_events(path, strict=True)
+    assert [e["type"] for e in events] == ["fl_cohort", "fl_tier",
+                                           "fl_tier"]
+    assert all(e["schema"] == SCHEMA_VERSION for e in events)
+    assert events[0]["clients"] == 64
+    assert events[2]["tier"] == "server"
+
+
+def test_validate_event_fleet_required_fields():
+    """fl_cohort / fl_tier events missing their per-type required fields
+    must be flagged, and pre-v3 streams stay valid under the v3 reader."""
+    base = {"schema": SCHEMA_VERSION, "run_id": "r", "seq": 1, "t": 0.0}
+    assert validate_event({**base, "type": "fl_cohort", "round": 0,
+                           "tier": "edge", "cohort": 0}) == []
+    assert validate_event({**base, "type": "fl_cohort", "round": 0,
+                           "tier": "edge"}) != []      # missing cohort
+    assert validate_event({**base, "type": "fl_tier", "round": 0,
+                           "tier": "server"}) == []
+    assert validate_event({**base, "type": "fl_tier", "round": 0}) != []
+    # v2 streams (serving lifecycle) remain valid under the v3 reader.
+    assert validate_event({**base, "schema": 2, "type": "request_done",
+                           "req": "a", "tokens": 3}) == []
+
+
 def test_eventlog_concurrent_writers(tmp_path):
     """10 threads x 50 events through one log: every event lands intact
     (one write() under the lock), seq is a permutation of 1..500."""
