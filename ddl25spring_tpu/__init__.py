@@ -26,7 +26,8 @@ Subpackages:
   serving   — production inference: paged KV cache + continuous-batching
               scheduler + Poisson load front end (bitwise-parity with
               models.generate)
-  telemetry — schema-versioned JSONL event stream, comm accounting,
+  telemetry — schema-versioned JSONL event stream, span tracing
+              (trace/span contexts, Perfetto export), comm accounting,
               heartbeat liveness, metrics registry
   utils     — pytree helpers, timing, checkpointing, logging
 """

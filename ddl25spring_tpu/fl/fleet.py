@@ -49,7 +49,11 @@ processed it.
 
 Telemetry (schema v3): one ``fl_cohort`` event per cohort dispatch and
 one ``fl_tier`` event per tier per round, with exact payload-byte
-accounting (telemetry.comm.tree_bytes) of what crossed into each tier —
+accounting (telemetry.comm.tree_bytes) of what crossed into each tier;
+since schema v4 the same structure is also a SPAN TREE (telemetry/
+trace.py) — an ``fl_round`` root with per-tier children and per-dispatch
+``cohort`` grandchildren on the "fleet" trace, contexts passed explicitly
+down the tier methods (pinned complete in tests/test_fleet.py) —
 m·|Δ| client-uplink bytes into the edges, E·|Δ| edge-uplink bytes into
 the server. Defense memory honesty: selection/aggregation defenses need
 the tier's full input stack (Krum's O(n²) distance matrix is over all n
@@ -61,6 +65,7 @@ vmapped reference exactly (the Krum-at-cohort-scale bar).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Tuple
@@ -72,6 +77,7 @@ import numpy as np
 from .. import rng as rngmod
 from ..config import FLConfig
 from ..telemetry.comm import tree_bytes
+from ..telemetry.trace import Tracer
 from ..utils import pytree as pt
 from .defenses import stack_flat, unstack_flat
 from .federated_data import FederatedDataset
@@ -284,6 +290,12 @@ class FleetFedAvgServer(_ServerBase):
                          cfg, algorithm="fleet-fedavg", telemetry=telemetry)
         self.source = source
         self.fleet = fleet
+        # Span tree per round (telemetry/trace.py): round → tier → cohort,
+        # mirroring the fl_cohort/fl_tier flat events — the tree is the
+        # causal structure, the flat events keep the exact byte accounting.
+        # Contexts are passed down the tier methods explicitly; nothing
+        # enters the compiled cohort steps.
+        self._tracer = Tracer(telemetry.events) if telemetry else None
         self._manifest_extra = {"fleet": dataclasses.asdict(fleet)}
         # Per-client upload payload, exact from leaf shapes/dtypes: f32
         # deltas, or the same-width int32 fixed-point tree under secagg.
@@ -385,9 +397,21 @@ class FleetFedAvgServer(_ServerBase):
                 round=r, tier=tier, cohort=c, edge=e, clients=n_real,
                 payload_bytes=n_real * self._client_payload_bytes)
 
+    def _span(self, name: str, parent=None, **attrs):
+        """A tracer span (or a no-op without telemetry). ``parent`` is the
+        enclosing Span; the context yields this tier's Span to pass one
+        level further down. Durations are HOST-side: a cohort span covers
+        gather + dispatch (the device may still be folding under async
+        dispatch), a tier span closes on the synced aggregate."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(
+            name, parent=parent.ctx if parent is not None else None,
+            trace="fleet" if parent is None else None, **attrs)
+
     # ----------------------------------------------------------- edge tier
     def _stream_edge(self, params, r: int, e: int, eidx: np.ndarray,
-                     weights: np.ndarray) -> PyTree:
+                     weights: np.ndarray, parent=None) -> PyTree:
         """One edge's round in plain streaming mode: O(W) device clients
         at a time, sequential fold into the carried aggregate."""
         W = self.fleet.cohort_width
@@ -401,17 +425,18 @@ class FleetFedAvgServer(_ServerBase):
                     [cidx, np.full(W - n_real, cidx[0], cidx.dtype)])
                 cw = np.concatenate(
                     [cw, np.zeros(W - n_real, np.float32)])
-            xs, ys, ms = self.source.cohort(cidx)
-            keys = jax.vmap(jax.random.key)(
-                jnp.asarray(self.client_seeds(r, cidx)))
-            acc = self._stream_step(params, acc, jnp.asarray(xs),
-                                    jnp.asarray(ys), jnp.asarray(ms),
-                                    keys, jnp.asarray(cw))
+            with self._span("cohort", parent, cohort=c, clients=n_real):
+                xs, ys, ms = self.source.cohort(cidx)
+                keys = jax.vmap(jax.random.key)(
+                    jnp.asarray(self.client_seeds(r, cidx)))
+                acc = self._stream_step(params, acc, jnp.asarray(xs),
+                                        jnp.asarray(ys), jnp.asarray(ms),
+                                        keys, jnp.asarray(cw))
             self._emit_cohort(r, "edge", e, c, n_real)
         return acc
 
-    def _collect_edge(self, params, r: int, e: int, eidx: np.ndarray
-                      ) -> np.ndarray:
+    def _collect_edge(self, params, r: int, e: int, eidx: np.ndarray,
+                      parent=None) -> np.ndarray:
         """One edge's round in defense mode: stream cohorts, collect the
         per-client flat deltas [m_e, P] on the host."""
         W = self.fleet.cohort_width
@@ -422,18 +447,19 @@ class FleetFedAvgServer(_ServerBase):
             if n_real < W:
                 cidx = np.concatenate(
                     [cidx, np.full(W - n_real, cidx[0], cidx.dtype)])
-            xs, ys, ms = self.source.cohort(cidx)
-            keys = jax.vmap(jax.random.key)(
-                jnp.asarray(self.client_seeds(r, cidx)))
-            flat = self._collect_step(params, jnp.asarray(xs),
-                                      jnp.asarray(ys), jnp.asarray(ms),
-                                      keys)
-            rows.append(np.asarray(flat)[:n_real])
+            with self._span("cohort", parent, cohort=c, clients=n_real):
+                xs, ys, ms = self.source.cohort(cidx)
+                keys = jax.vmap(jax.random.key)(
+                    jnp.asarray(self.client_seeds(r, cidx)))
+                flat = self._collect_step(params, jnp.asarray(xs),
+                                          jnp.asarray(ys), jnp.asarray(ms),
+                                          keys)
+                rows.append(np.asarray(flat)[:n_real])
             self._emit_cohort(r, "edge", e, c, n_real)
         return np.concatenate(rows, axis=0)
 
-    def _secagg_edge(self, params, r: int, e: int, eidx: np.ndarray
-                     ) -> PyTree:
+    def _secagg_edge(self, params, r: int, e: int, eidx: np.ndarray,
+                     parent=None) -> PyTree:
         """One edge's round under pairwise masking: the host only ever
         observes masked int32 sums; wrapping np.int32 accumulation across
         cohorts is exact on the mod-2^32 ring."""
@@ -454,17 +480,18 @@ class FleetFedAvgServer(_ServerBase):
             if n_real < W:
                 cidx = np.concatenate(
                     [cidx, np.full(W - n_real, cidx[0], cidx.dtype)])
-            xs, ys, ms = self.source.cohort(cidx)
-            keys = jax.vmap(jax.random.key)(
-                jnp.asarray(self.client_seeds(r, cidx)))
-            part = self._secagg_step(
-                params, jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(ms),
-                keys, jnp.asarray(cidx), jnp.asarray(pair_ids),
-                jnp.asarray(pair_valid), mask_root, jnp.int32(r),
-                jnp.asarray(active))
-            part = jax.tree.map(np.asarray, part)
-            total = part if total is None else jax.tree.map(
-                np.add, total, part)          # int32: wraps mod 2^32
+            with self._span("cohort", parent, cohort=c, clients=n_real):
+                xs, ys, ms = self.source.cohort(cidx)
+                keys = jax.vmap(jax.random.key)(
+                    jnp.asarray(self.client_seeds(r, cidx)))
+                part = self._secagg_step(
+                    params, jnp.asarray(xs), jnp.asarray(ys),
+                    jnp.asarray(ms), keys, jnp.asarray(cidx),
+                    jnp.asarray(pair_ids), jnp.asarray(pair_valid),
+                    mask_root, jnp.int32(r), jnp.asarray(active))
+                part = jax.tree.map(np.asarray, part)
+                total = part if total is None else jax.tree.map(
+                    np.add, total, part)          # int32: wraps mod 2^32
             self._emit_cohort(r, "edge", e, c, n_real)
         # Dequantize the cancelled sum and average uniformly — the same
         # single multiply by the host constant scale/m as
@@ -474,60 +501,66 @@ class FleetFedAvgServer(_ServerBase):
                                self._secagg_scale / m_e)
 
     def _edge_round(self, params, r: int, e: int, eidx: np.ndarray,
-                    counts: np.ndarray) -> PyTree:
+                    counts: np.ndarray, parent=None) -> PyTree:
         """One edge aggregate: stream, then apply the edge TierPolicy."""
         pol = self.fleet.edge
-        if pol.secure_agg is not None:
-            return self._secagg_edge(params, r, e, eidx)
-        w = np.asarray(_round_weights(
-            jnp.asarray(self._weighting_counts(counts))))
-        if self._collect:
-            flat = self._collect_edge(params, r, e, eidx)
-            flat_hook = getattr(pol.defense, "flat_hook", None)
-            if flat_hook is not None:
-                # The adapter's flat core consumes the collected [m_e, P]
-                # stack directly — no stacked-pytree round trip. Same ops
-                # as the pytree entry point, so the bitwise parity with
-                # FedAvgGradServer(defense=...) is unchanged.
-                agg = self._unflatten_vec(
-                    flat_hook(jnp.asarray(flat), jnp.asarray(w)))
+        with self._span("tier", parent, tier="edge", edge=e,
+                        clients=len(eidx)) as tspan:
+            if pol.secure_agg is not None:
+                return self._secagg_edge(params, r, e, eidx, tspan)
+            w = np.asarray(_round_weights(
+                jnp.asarray(self._weighting_counts(counts))))
+            if self._collect:
+                flat = self._collect_edge(params, r, e, eidx, tspan)
+                flat_hook = getattr(pol.defense, "flat_hook", None)
+                if flat_hook is not None:
+                    # The adapter's flat core consumes the collected
+                    # [m_e, P] stack directly — no stacked-pytree round
+                    # trip. Same ops as the pytree entry point, so the
+                    # bitwise parity with FedAvgGradServer(defense=...)
+                    # is unchanged.
+                    agg = self._unflatten_vec(
+                        flat_hook(jnp.asarray(flat), jnp.asarray(w)))
+                else:
+                    stacked = unstack_flat(jnp.asarray(flat), params)
+                    agg = pol.defense(stacked, jnp.asarray(w))
             else:
-                stacked = unstack_flat(jnp.asarray(flat), params)
-                agg = pol.defense(stacked, jnp.asarray(w))
-        else:
-            agg = self._stream_edge(params, r, e, eidx, w)
-        if pol.dp_noise_multiplier > 0:
-            sigma = pol.dp_noise_multiplier * pol.dp_clip / len(eidx)
-            agg = pt.tree_add(agg, gaussian_noise_like(
-                self._noise_key(r, 0, e), agg, sigma))
-        return agg
+                agg = self._stream_edge(params, r, e, eidx, w, tspan)
+            if pol.dp_noise_multiplier > 0:
+                sigma = pol.dp_noise_multiplier * pol.dp_clip / len(eidx)
+                agg = pt.tree_add(agg, gaussian_noise_like(
+                    self._noise_key(r, 0, e), agg, sigma))
+            return agg
 
     # ---------------------------------------------------------- server tier
     def _server_round(self, r: int, edge_aggs: List[PyTree],
-                      edge_counts: np.ndarray) -> PyTree:
+                      edge_counts: np.ndarray, parent=None) -> PyTree:
         """Reduce the E edge aggregates per the server TierPolicy. Skipped
         entirely in the flat case (E=1, empty policy) so the flat path is
-        bitwise the single edge's fold."""
+        bitwise the single edge's fold — and emits no server-tier span,
+        because no server tier ran."""
         pol = self.fleet.server
         if (len(edge_aggs) == 1 and pol.defense is None
                 and pol.dp_clip is None and pol.dp_noise_multiplier == 0):
             return edge_aggs[0]
-        stacked = pt.tree_stack(edge_aggs)
-        if pol.dp_clip is not None:
-            stacked = jax.vmap(
-                lambda t: clip_by_global_norm(t, pol.dp_clip))(stacked)
-        ew = _round_weights(jnp.asarray(
-            self._weighting_counts(edge_counts)))
-        if pol.defense is not None:
-            agg = pol.defense(stacked, ew)
-        else:
-            agg = pt.tree_weighted_fold(stacked, ew)
-        if pol.dp_noise_multiplier > 0:
-            sigma = (pol.dp_noise_multiplier * pol.dp_clip
-                     / len(edge_aggs))
-            agg = pt.tree_add(agg, gaussian_noise_like(
-                self._noise_key(r, 1, 0), agg, sigma))
-        return agg
+        with self._span("tier", parent, tier="server",
+                        inputs=len(edge_aggs)):
+            stacked = pt.tree_stack(edge_aggs)
+            if pol.dp_clip is not None:
+                stacked = jax.vmap(
+                    lambda t: clip_by_global_norm(t, pol.dp_clip))(stacked)
+            ew = _round_weights(jnp.asarray(
+                self._weighting_counts(edge_counts)))
+            if pol.defense is not None:
+                agg = pol.defense(stacked, ew)
+            else:
+                agg = pt.tree_weighted_fold(stacked, ew)
+            if pol.dp_noise_multiplier > 0:
+                sigma = (pol.dp_noise_multiplier * pol.dp_clip
+                         / len(edge_aggs))
+                agg = pt.tree_add(agg, gaussian_noise_like(
+                    self._noise_key(r, 1, 0), agg, sigma))
+            return agg
 
     # ------------------------------------------------------------ the round
     def _round(self, params, r):
@@ -537,26 +570,29 @@ class FleetFedAvgServer(_ServerBase):
         parts = np.array_split(np.arange(m), self.fleet.edges)
         edge_aggs: List[PyTree] = []
         edge_counts = np.empty(len(parts), np.int64)
-        for e, pos in enumerate(parts):
-            edge_aggs.append(
-                self._edge_round(params, r, e, idx[pos], counts[pos]))
-            edge_counts[e] = (int(counts[pos].sum())
-                              if self.fleet.weighting == "samples"
-                              else len(pos))
-        tel = self.telemetry
-        if tel is not None:
-            tel.events.fl_tier(
-                round=r, tier="edge", edges=len(parts), clients=m,
-                payload_bytes=m * self._client_payload_bytes,
-                wire=("int32-masked"
-                      if self.fleet.edge.secure_agg is not None
-                      else "float32"))
-            tel.events.fl_tier(
-                round=r, tier="server", inputs=len(edge_aggs),
-                payload_bytes=(len(edge_aggs)
-                               * self._client_payload_bytes))
-        agg = self._server_round(r, edge_aggs, edge_counts)
-        return pt.tree_sub(params, agg)
+        with self._span("fl_round", round=r, clients=m,
+                        edges=len(parts)) as rspan:
+            for e, pos in enumerate(parts):
+                edge_aggs.append(
+                    self._edge_round(params, r, e, idx[pos], counts[pos],
+                                     rspan))
+                edge_counts[e] = (int(counts[pos].sum())
+                                  if self.fleet.weighting == "samples"
+                                  else len(pos))
+            tel = self.telemetry
+            if tel is not None:
+                tel.events.fl_tier(
+                    round=r, tier="edge", edges=len(parts), clients=m,
+                    payload_bytes=m * self._client_payload_bytes,
+                    wire=("int32-masked"
+                          if self.fleet.edge.secure_agg is not None
+                          else "float32"))
+                tel.events.fl_tier(
+                    round=r, tier="server", inputs=len(edge_aggs),
+                    payload_bytes=(len(edge_aggs)
+                                   * self._client_payload_bytes))
+            agg = self._server_round(r, edge_aggs, edge_counts, rspan)
+            return pt.tree_sub(params, agg)
 
 
 # ------------------------------------------------------------ the reference

@@ -44,10 +44,12 @@ re-wiring problem, not a resharding one — and is rejected loudly
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, NamedTuple, Optional, Tuple
 
+from ..telemetry.trace import Tracer
 from .faults import ReplicaLossError
 
 
@@ -128,6 +130,12 @@ class ElasticController:
         self.mirror_every = int(mirror_every)
         self._stats = stats
         self._telemetry = telemetry
+        # Recovery phases as a span tree (telemetry/trace.py): a ``remesh``
+        # root on the run's "train" trace with rebuild/restore/persist/
+        # replay children, so the trace timeline shows WHERE a recovery's
+        # seconds went next to the dispatch spans it interrupted.
+        self._tracer = (Tracer(telemetry.events)
+                        if telemetry is not None else None)
         self._log = log_fn
         self._mirror: Optional[Tuple[int, Any]] = None  # (step, host state)
         self._edges = 0
@@ -180,36 +188,55 @@ class ElasticController:
                   f"lost {lost} of {old_world}; re-meshing onto "
                   f"{new_world} survivors")
         self._beat(failed_at, "remesh")
+        rroot = (self._tracer.start("remesh", trace="train", it=failed_at,
+                                    old_world=old_world,
+                                    new_world=new_world)
+                 if self._tracer is not None else None)
 
-        template, raw_step, window_shard = self._build(new_mesh)
+        def _span(name):
+            if rroot is not None:
+                return self._tracer.span(name, parent=rroot.ctx)
+            return contextlib.nullcontext()
+
+        with _span("rebuild"):
+            template, raw_step, window_shard = self._build(new_mesh)
         if self._mirror is not None:
             resume_step, host_state = self._mirror
-            state = dp.reshard_state(host_state, template)
+            with _span("restore"):
+                state = dp.reshard_state(host_state, template)
             path = "mirror"
         elif self._ckpt is not None:
             try:
-                state = self._ckpt.restore(template)
+                with _span("restore"):
+                    state = self._ckpt.restore(template)
             except FileNotFoundError:
+                if rroot is not None:
+                    rroot.end(error=True)
                 raise err from None     # nothing recoverable on disk either
             resume_step = int(self._ckpt.restored_step)
             path = "checkpoint"
         else:
+            if rroot is not None:
+                rroot.end(error=True)
             raise err                   # no mirror, no checkpoint: fatal
 
         if self._ckpt is not None:
             # Persist the M-way layout NOW: a second loss (or a plain
             # preemption) must restore cross-topology work, not redo it.
             # overwrite: step ``resume_step`` on disk is the N-way lineage.
-            self._ckpt.save(resume_step, state, force=True, overwrite=True)
+            with _span("persist"):
+                self._ckpt.save(resume_step, state, force=True,
+                                overwrite=True)
 
-        batches = self._make_batches(new_world)
-        last_beat = 0.0
-        for i in range(resume_step):    # stream replay at the new width
-            next(batches)
-            now = time.perf_counter()
-            if now - last_beat >= 0.5:
-                self._beat(i, "remesh")
-                last_beat = now
+        with _span("replay"):
+            batches = self._make_batches(new_world)
+            last_beat = 0.0
+            for i in range(resume_step):    # stream replay at the new width
+                next(batches)
+                now = time.perf_counter()
+                if now - last_beat >= 0.5:
+                    self._beat(i, "remesh")
+                    last_beat = now
 
         step_fn = self._rewrap(raw_step, start=dispatch + 1)
         self.mesh = new_mesh
@@ -218,6 +245,8 @@ class ElasticController:
         if self.mirror_every > 0:
             self.note_edge(resume_step, state)
 
+        if rroot is not None:
+            rroot.end(path=path, steps_replayed=failed_at - resume_step)
         rec = RemeshRecord(
             detected_at=failed_at, resume_step=resume_step,
             dispatch=dispatch, old_world=old_world, new_world=new_world,

@@ -34,6 +34,20 @@ Telemetry: every lifecycle edge emits a ``request_*`` event (schema v2,
 telemetry/events.py) through the shared JSONL stream — queue wait, TTFT,
 per-token progress, blocks held — rendered as p50/p95/p99 by
 `experiments/obs_report.py`.
+
+Tracing (schema v4, telemetry/trace.py): each request is ONE trace
+(trace_id = the request id) with a ``request`` root span and
+``queue`` → ``prefill`` (with per-tick ``prefill_chunk`` children) →
+``decode`` → ``retire`` child spans, all on the scheduler's clock — so
+queue-wait/TTFT percentiles and the span timeline agree by construction.
+Contexts are held host-side per request and passed explicitly; nothing
+crosses into the compiled engine programs, so the engine's two-programs
+contract and the zero-in-jit-overhead invariant are untouched. A
+``prefill_chunk`` span covers the whole engine step that advanced the
+chunk (one compiled call serves every slot — the per-slot share is not
+observable from the host), flagged with the chunk index; reassemble with
+``telemetry.trace.trace_trees`` or export via
+``experiments/trace_export.py``.
 """
 
 from __future__ import annotations
@@ -46,6 +60,7 @@ import jax
 import numpy as np
 
 from ..telemetry.events import EventLog
+from ..telemetry.trace import Span, Tracer
 from .engine import Engine
 
 
@@ -121,6 +136,14 @@ class Scheduler:
         self.events = events
         self.token_events = token_events
         self.clock = clock
+        # Per-request trace trees ride the scheduler's OWN clock (the load
+        # harness fast-forwards it through idle gaps), so span timestamps
+        # and the queue_wait_s/ttft_s latency fields share one timebase.
+        self.tracer = (Tracer(events,
+                              clock_ns=lambda: int(self.clock() * 1e9))
+                       if events is not None else None)
+        self._spans: Dict[str, Dict[str, Span]] = {}   # rid -> open spans
+        self._chunks: Dict[str, int] = {}              # rid -> chunks done
         self.queue: List[Request] = []
         self.records: Dict[str, RequestRecord] = {}
         self._by_slot: Dict[int, Request] = {}
@@ -154,6 +177,13 @@ class Scheduler:
             self.events.request_enqueue(
                 req=req.rid, prompt_len=len(req.prompt), max_new=req.max_new,
                 temperature=req.temperature, queued=len(self.queue))
+        if self.tracer:
+            root = self.tracer.start("request", trace=req.rid,
+                                     prompt_len=len(req.prompt),
+                                     max_new=req.max_new)
+            self._spans[req.rid] = {
+                "root": root,
+                "queue": self.tracer.start("queue", parent=root.ctx)}
 
     @property
     def outstanding(self) -> int:
@@ -167,8 +197,23 @@ class Scheduler:
         if not self.engine.busy:
             return []
         emitted: List[Tuple[str, int]] = []
+        chunk_spans: List[Tuple[str, Span]] = []
+        if self.tracer:
+            # Slots without a first token advance exactly one prefill
+            # chunk in this step (engine contract); open their chunk spans
+            # BEFORE the step so the span covers the compiled call.
+            for slot, req in self._by_slot.items():
+                if self.records[req.rid].first_token_t is None:
+                    i = self._chunks.get(req.rid, 0)
+                    self._chunks[req.rid] = i + 1
+                    chunk_spans.append((req.rid, self.tracer.start(
+                        "prefill_chunk",
+                        parent=self._spans[req.rid]["prefill"].ctx,
+                        chunk=i)))
         events = self.engine.step()
         now = self.clock()   # post-step: token timestamps include the step
+        for _, s in chunk_spans:
+            s.end()
         eos_retired: set = set()
         for ev in events:
             if ev.slot in eos_retired:
@@ -185,6 +230,12 @@ class Scheduler:
             rec.tokens.append(ev.token)
             if ev.first:
                 rec.first_token_t = now
+                if self.tracer:
+                    spans = self._spans[req.rid]
+                    spans["prefill"].end(
+                        chunks=self._chunks.get(req.rid, 0))
+                    spans["decode"] = self.tracer.start(
+                        "decode", parent=spans["root"].ctx, slot=ev.slot)
             if self.events and self.token_events:
                 self.events.request_token(req=req.rid,
                                           i=len(rec.tokens) - 1,
@@ -207,6 +258,21 @@ class Scheduler:
                 rec.done_t = now
                 del self._by_slot[ev.slot]
                 self.completed += 1
+                if self.tracer:
+                    spans = self._spans.pop(req.rid)
+                    self._chunks.pop(req.rid, None)
+                    # Always opened at the first token (a one-token request
+                    # gets a zero-duration decode: first == done in one
+                    # engine event).
+                    spans["decode"].end(tokens=len(rec.tokens))
+                    # The retire point: blocks (the whole worst-case
+                    # reservation) return to the pool here — an instant on
+                    # the timeline rather than an interval, since the free
+                    # is a host list append.
+                    self.tracer.start("retire", parent=spans["root"].ctx,
+                                      blocks_freed=rec.blocks).end()
+                    spans["root"].end(tokens=len(rec.tokens),
+                                      **({"eos": True} if early_eos else {}))
                 if self.events:
                     self.events.request_done(
                         req=req.rid, tokens=len(rec.tokens),
@@ -237,6 +303,12 @@ class Scheduler:
                                       len(self._by_slot))
             rec = self.records[head.rid]
             rec.admit_t = self.clock()
+            if self.tracer:
+                spans = self._spans[head.rid]
+                spans["queue"].end()
+                spans["prefill"] = self.tracer.start(
+                    "prefill", parent=spans["root"].ctx, slot=slot,
+                    blocks=rec.blocks)
             if self.events:
                 self.events.request_prefill(
                     req=head.rid, slot=slot, blocks=rec.blocks,

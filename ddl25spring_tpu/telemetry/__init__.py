@@ -14,6 +14,10 @@ One observability subsystem the whole stack reports through:
   analytic FLOPs.
 - ``heartbeat``: atomic liveness file consumed by experiments/watchdog.py
   as a first-class stall signal.
+- ``trace``: span contexts (trace/span/parent ids, explicit propagation)
+  over the event stream — per-request/per-round causal timelines,
+  exported to Perfetto by experiments/trace_export.py and watched live by
+  experiments/slo_monitor.py.
 
 ``Telemetry`` bundles the per-run pieces (event log + heartbeat +
 registry) behind one handle the trainers/servers accept.
@@ -30,6 +34,8 @@ from .events import (EventLog, SCHEMA_VERSION, default_run_id, read_events,
                      validate_event)
 from .heartbeat import Heartbeat, read_heartbeat
 from .registry import MetricsRegistry
+from .trace import (Span, SpanContext, Spans, Tracer, device_trace,
+                    trace_trees, tree_check)
 
 # comm.py imports jax at module level; everything else here is stdlib-only.
 # Lazy re-export (PEP 562) keeps jax OUT of processes that only read
@@ -46,9 +52,10 @@ def __getattr__(name: str):
 
 __all__ = [
     "CommProfile", "EventLog", "Heartbeat", "MetricsRegistry",
-    "SCHEMA_VERSION", "Telemetry", "default_run_id", "flops_crosscheck",
-    "hlo_cost", "measure_comm", "read_events", "read_heartbeat",
-    "validate_event",
+    "SCHEMA_VERSION", "Span", "SpanContext", "Spans", "Telemetry", "Tracer",
+    "default_run_id", "device_trace", "flops_crosscheck", "hlo_cost",
+    "measure_comm", "read_events", "read_heartbeat", "trace_trees",
+    "tree_check", "validate_event",
 ]
 
 EVENTS_NAME = "events.jsonl"
@@ -81,6 +88,12 @@ class Telemetry:
                                run_id=self.run_id)
         self.heartbeat = Heartbeat(os.path.join(out_dir, HEARTBEAT_NAME))
         self.registry = MetricsRegistry()
+        # No default Tracer here: every emitter needs its own (the
+        # serving scheduler binds its fast-forwarded clock, the trainers
+        # their phase accumulator), and an unused one would burn a slot
+        # in the process-wide tracer-id sequence, making span ids depend
+        # on how many Telemetry bundles were ever constructed. Build one
+        # with ``Tracer(telemetry.events)``.
 
     @property
     def events_path(self) -> str:

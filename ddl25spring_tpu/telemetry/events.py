@@ -18,9 +18,13 @@ Write contract:
   interleave); a reader tolerates a torn FINAL line either way, and a
   reopening writer truncates one (below).
 - Every event carries ``schema`` (version), ``run_id``, ``seq`` (per-writer
-  monotonic), ``t`` (epoch seconds) and ``type``. Unknown types and extra
-  fields are legal — readers must ignore what they don't know (the same
-  forward-compat posture as ResultSink's header widening).
+  monotonic), ``t`` (epoch seconds) and ``type``. Extra fields are always
+  legal — readers must ignore what they don't know (the same forward-compat
+  posture as ResultSink's header widening). Event TYPES are closed per
+  schema version: non-strict readers still skip nothing, but
+  ``validate_event`` flags an unknown type at/below its own version (a
+  typo) and names the offending type when the version is newer (a future
+  schema's addition).
 """
 
 from __future__ import annotations
@@ -36,17 +40,25 @@ from typing import Any, Dict, Iterator, List, Optional
 # request_token / request_done — serving/scheduler.py). v3: fleet-scale FL
 # (fl/fleet.py) — ``fl_cohort`` (one device dispatch of a streamed cohort)
 # and ``fl_tier`` (one aggregation tier's per-round summary with exact
-# payload-byte accounting). Version bumps are additive: a v3 reader
-# accepts v1/v2 streams unchanged, and older readers reject v3 (the
-# "future schema" rule in validate_event) rather than misread it.
-SCHEMA_VERSION = 3
+# payload-byte accounting). v4: distributed tracing + live SLOs —
+# ``span`` (one closed trace span: telemetry/trace.py's Tracer, exported
+# to Chrome trace JSON by experiments/trace_export.py) and
+# ``slo_violation`` (experiments/slo_monitor.py's rolling-window verdicts).
+# Version bumps are additive: a v4 reader accepts v1/v2/v3 streams
+# unchanged, and older readers reject v4 (the "future schema" rule in
+# validate_event) rather than misread it.
+SCHEMA_VERSION = 4
 
-# Event types this schema version defines. Emitters may add new types
-# freely; ``validate_event`` checks base fields for ALL types and the
-# per-type required fields only for the known ones.
+# Event types this schema version defines. The type set is CLOSED per
+# schema version: ``validate_event`` checks base fields for all types, the
+# per-type required fields for the known ones, and (since v4) flags an
+# unknown type carrying a schema at/below the reader's version — an
+# unknown type is either a typo (same version) or a future schema's
+# addition (whose version bump already flags it, by name).
 EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
-               "request_done", "fl_cohort", "fl_tier")
+               "request_done", "fl_cohort", "fl_tier", "span",
+               "slo_violation")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -81,6 +93,20 @@ _REQUIRED: Dict[str, tuple] = {
     # hierarchical-topology comparisons in PAPERS.md need.
     "fl_cohort": ("round", "tier", "cohort"),
     "fl_tier": ("round", "tier"),
+    # Distributed tracing (telemetry/trace.py, schema v4). One event per
+    # CLOSED span: ``trace_id`` groups a causal tree (one serving request,
+    # one FL round, one training run), ``span_id``/``parent_span_id``
+    # carry the tree structure explicitly (no thread-locals — contexts are
+    # passed by hand, so nothing leaks into jit), ``start_ns``/``dur_ns``
+    # are the tracer clock's monotonic nanoseconds. Extra fields are span
+    # attributes. Rendered by obs_report's "traces" section; exported to
+    # Perfetto/chrome://tracing by experiments/trace_export.py.
+    "span": ("name", "trace_id", "span_id", "start_ns", "dur_ns"),
+    # Live SLO monitoring (experiments/slo_monitor.py, schema v4): one
+    # event per rolling-window violation — ``slo`` names the objective
+    # (e.g. "ttft_p99_s"), ``value``/``threshold`` the measurement vs the
+    # target, ``window_s`` the window it was measured over.
+    "slo_violation": ("slo",),
 }
 
 
@@ -96,7 +122,8 @@ class EventLog:
     >>> log.step(it=10, loss=2.31, dt_s=0.4)
     """
 
-    def __init__(self, path: str, run_id: Optional[str] = None):
+    def __init__(self, path: str, run_id: Optional[str] = None, *,
+                 heal: bool = True):
         self.path = path
         self.run_id = run_id or default_run_id()
         self._seq = 0
@@ -110,6 +137,25 @@ class EventLog:
                            0o644)
         self.write_errors = 0
         self._torn_tail = False  # our own partial write left file mid-line
+        if not heal:
+            # A SIDECAR writer (slo_monitor appending verdicts into a LIVE
+            # stream) must be append-only: the heal below interprets a
+            # missing final newline as a dead writer's fragment, but on a
+            # live stream it is another process's in-flight line, and
+            # truncating it would corrupt that writer's event mid-write.
+            # If the file DOES end mid-line right now (a crashed
+            # predecessor's fragment), seal it with a leading newline on
+            # our first emit instead — worst case (the line completes in
+            # between) readers skip one blank line.
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        self._torn_tail = f.read(1) != b"\n"
+            except OSError:
+                pass
+            return
         # Heal a torn final line left by a crashed predecessor (a relaunch
         # reusing the same telemetry dir): without healing, this writer's
         # first event would merge into the fragment, turning an expected
@@ -118,7 +164,8 @@ class EventLog:
         # newline discards exactly the bytes every reader would drop; the
         # write contract (whole lines in one write()) means a file not
         # ending in '\n' is a dead writer's fragment, not an in-flight
-        # append.
+        # append. Writers taking OVER a dir heal; sidecars sharing a LIVE
+        # stream pass heal=False (above).
         try:
             size = os.fstat(self._fd).st_size
             if size > 0:
@@ -237,6 +284,20 @@ class EventLog:
     def fl_tier(self, *, round: int, tier: str, **fields) -> Dict[str, Any]:
         return self.emit("fl_tier", round=round, tier=tier, **fields)
 
+    # Distributed tracing (schema v4; telemetry/trace.py's Tracer emits).
+    def span(self, *, name: str, trace_id: str, span_id: str,
+             start_ns: int, dur_ns: int, parent_span_id: Optional[str] = None,
+             **fields) -> Dict[str, Any]:
+        if parent_span_id is not None:
+            fields["parent_span_id"] = parent_span_id
+        return self.emit("span", name=name, trace_id=trace_id,
+                         span_id=span_id, start_ns=start_ns, dur_ns=dur_ns,
+                         **fields)
+
+    # Live SLO monitoring (schema v4; experiments/slo_monitor.py emits).
+    def slo_violation(self, *, slo: str, **fields) -> Dict[str, Any]:
+        return self.emit("slo_violation", slo=slo, **fields)
+
     def close(self) -> None:
         with self._lock:
             if self._fd is not None:
@@ -282,20 +343,33 @@ def _sanitize(obj):
 def validate_event(event: Dict[str, Any]) -> List[str]:
     """Schema check; returns a list of problems (empty = valid).
 
-    Base fields are required for every event; per-type required fields only
-    for the types this schema version knows. A FUTURE schema version is a
-    problem (the reader can't promise to understand it); unknown event
-    types are not (forward compat).
+    Base fields are required for every event; per-type required fields for
+    the types this schema version knows. A FUTURE schema version is a
+    problem (the reader can't promise to understand it), and the message
+    NAMES the event type that carried it — "schema 5 is newer" alone left
+    a v5-writer-vs-v4-reader failure opaque about which emitter was ahead.
+    An unknown type is rejected only when its declared schema is at/below
+    the reader's version (there the type set is closed, so it can only be
+    a typo); a newer stream's genuinely-new types are covered — by name —
+    by the future-schema problem instead.
     """
     problems = [f"missing field {f!r}" for f in _BASE_FIELDS
                 if f not in event]
     schema = event.get("schema")
+    etype = event.get("type")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:
-        problems.append(f"schema {schema} is newer than reader "
-                        f"({SCHEMA_VERSION})")
-    for f in _REQUIRED.get(event.get("type"), ()):
+        problems.append(
+            f"schema {schema} is newer than reader ({SCHEMA_VERSION}): "
+            f"cannot validate event type {etype!r} — upgrade the reader "
+            "or re-record at the reader's schema")
+    elif etype is not None and etype not in EVENT_TYPES:
+        problems.append(
+            f"unknown event type {etype!r} for schema "
+            f"{schema if isinstance(schema, int) else SCHEMA_VERSION} "
+            f"(known: {', '.join(EVENT_TYPES)})")
+    for f in _REQUIRED.get(etype, ()):
         if f not in event:
-            problems.append(f"{event.get('type')}: missing field {f!r}")
+            problems.append(f"{etype}: missing field {f!r}")
     return problems
 
 

@@ -1,8 +1,9 @@
 """MetricsRegistry: counters, gauges and histograms with one snapshot shape.
 
 The unification point for the repo's previously fragmented metric holders
-(ISSUE 2): `utils.tracing.Spans` wall-clock accumulators,
-`utils.tracing.StepTimer` per-step times, and `metrics.ResilienceStats`
+(ISSUE 2): `telemetry.trace.Spans` wall-clock accumulators (fed by the
+span Tracer or standalone), `telemetry.trace.StepTimer` per-step times,
+and `metrics.ResilienceStats`
 fault counters all land here through adapters (``absorb_*``), so one
 ``snapshot()`` carries everything a run report needs — and the run_end
 event in the JSONL stream is exactly that snapshot.
@@ -115,14 +116,14 @@ class MetricsRegistry:
 
     # -------------------------------------------------------------- adapters
     def absorb_spans(self, spans, prefix: str = "phase/") -> None:
-        """utils.tracing.Spans → ``phase/<name>_s`` gauges (total seconds)
+        """telemetry.trace.Spans → ``phase/<name>_s`` gauges (total seconds)
         and ``phase/<name>_count`` counters."""
         for name, total in spans.as_dict().items():
             self.gauge_set(f"{prefix}{name}_s", total)
             self.counter_set(f"{prefix}{name}_count", spans.count(name))
 
     def absorb_step_timer(self, timer, name: str = "step_time_s") -> None:
-        """utils.tracing.StepTimer → one histogram of its recorded steps."""
+        """telemetry.trace.StepTimer → one histogram of its recorded steps."""
         for t in list(timer.times):
             self.observe(name, t)
 

@@ -26,8 +26,8 @@ from ..metrics import ResilienceStats
 from ..models import llama
 from ..parallel import dp, make_mesh, pp
 from ..resilience.preemption import PreemptionHandler
+from ..telemetry.trace import Spans, Tracer
 from ..tokenizers import load_tokenizer
-from ..utils.tracing import Spans
 
 
 @dataclass
@@ -250,6 +250,20 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     report.start_step = start_step
     report.resilience = stats if stats is not None else ResilienceStats()
     spans = Spans()  # phase accounting; absorbed into the registry at end
+    # One tracing path (telemetry/trace.py): dispatch spans feed the SAME
+    # phase accumulator they always did, and additionally land in the
+    # event stream as a ``dispatch`` root with stage/compute/checkpoint/
+    # sink children when telemetry is attached. Per-step mode samples at
+    # the step-event cadence (a span per iteration would dominate the
+    # stream); chunked mode traces every dispatch (already coarse).
+    tracer = Tracer(telemetry.events if telemetry is not None else None,
+                    phases=spans)
+
+    def _phase(name: str, parent, span_name: str):
+        if parent is not None:
+            return tracer.span(span_name, parent=parent.ctx, phase=name)
+        return spans(name)
+
     last_event_t = time.perf_counter()
     last_event_it = start_step - 1
     last_replay_beat = -math.inf  # first replayed batch always beats
@@ -302,7 +316,11 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     if steps_per_dispatch <= 1:
         with preempt:
             for it in range(train_cfg.iters):
-                with spans("data"):
+                droot = (tracer.start("dispatch", trace="train", it=it,
+                                      phase=False)
+                         if (telemetry is not None and it >= start_step
+                             and it % telemetry.step_every == 0) else None)
+                with _phase("data", droot, "stage"):
                     host_batch = next(batches).reshape(
                         n_data * train_cfg.batch_size, train_cfg.seq_len)
                 if it < start_step:
@@ -317,11 +335,13 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                             last_replay_beat = now
                     continue  # resume: replay stream, preserving data order
                 if preempt.requested:
+                    if droot is not None:
+                        droot.end(preempted=True)
                     _force_save(it)
                     break
                 last_it = it
                 t_iter = time.perf_counter()
-                with spans("dispatch"):
+                with _phase("dispatch", droot, "compute"):
                     state, loss = step_fn(state, shard_fn(host_batch))
                 if it + 1 == start_step + warmup_steps_excluded:
                     float(loss)  # hard sync before starting the timer
@@ -333,7 +353,8 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                     last_event_t, last_event_it = t_start, it
                 pending.append((it, loss))
                 if it % sink_every == 0 or it == train_cfg.iters - 1:
-                    _flush_losses()  # the sink boundary: host ring update
+                    with _phase("sink", droot, "sink"):
+                        _flush_losses()  # sink boundary: host ring update
                 if log_every and it % log_every == 0:
                     log_fn(f"iter {it}: loss {float(loss):.4f}")
                 if telemetry is not None:
@@ -371,13 +392,15 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                         # already wrote (start_step < it+1 <= old latest),
                         # and those stale entries must not survive as
                         # restore candidates.
-                        with spans("checkpoint"):
+                        with _phase("checkpoint", droot, "checkpoint"):
                             ckpt.save(it + 1, state, overwrite=True)
                         last_saved = it + 1
                     except Exception as e:
                         log_fn(f"periodic checkpoint at {it + 1} failed "
                                f"after retries ({type(e).__name__}: {e}); "
                                "continuing")
+                if droot is not None:
+                    droot.end()
     else:
         # ------------------------------------------------- chunked mode
         # NOTE: _run_elastic_loop mirrors this block (plus the recovery
@@ -392,8 +415,8 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
             chunks.append((edge, nxt))
             edge = nxt
 
-        def _window(it0, it1):
-            with spans("data"):
+        def _window(it0, it1, parent=None):
+            with _phase("data", parent, "stage"):
                 return np.stack([
                     next(batches).reshape(n_data * train_cfg.batch_size,
                                           train_cfg.seq_len)
@@ -413,16 +436,25 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                 if preempt.requested:
                     _force_save(it0)
                     break
-                window = staged if staged is not None else _window(it0, it1)
+                # One trace root per dispatch (the chunk IS the dispatch
+                # granularity); children cover this chunk's host work,
+                # including the NEXT window's staging — that overlap
+                # landing inside the compute-bound interval is exactly
+                # what the timeline should show.
+                droot = (tracer.start("dispatch", trace="train", it=it0,
+                                      steps=it1 - it0, phase=False)
+                         if telemetry is not None else None)
+                window = (staged if staged is not None
+                          else _window(it0, it1, droot))
                 staged = None
                 t_iter = time.perf_counter()
-                with spans("dispatch"):
+                with _phase("dispatch", droot, "compute"):
                     state, losses = step_fn(state, window_shard_fn(window))
                 # Stage the NEXT chunk's host window while the device runs
                 # this one: under async dispatch the tokenize/stack work
                 # overlaps compute instead of serializing after it.
                 if ci + 1 < len(chunks):
-                    staged = _window(*chunks[ci + 1])
+                    staged = _window(*chunks[ci + 1], droot)
                 last_it = it1 - 1
                 first_chunk = t_start is None
                 pending.append((it0, losses))
@@ -459,18 +491,21 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                     last_event_t, last_event_it = t_start, last_it
                 if (it1 - last_flush_edge >= sink_every
                         or it1 == train_cfg.iters):
-                    _flush_losses()  # sink boundary (chunk-edge quantized)
+                    with _phase("sink", droot, "sink"):
+                        _flush_losses()  # sink boundary (chunk-edge quantized)
                     last_flush_edge = it1
                 if ckpt is not None and (it1 // checkpoint_every
                                          ) > (it0 // checkpoint_every):
                     try:
-                        with spans("checkpoint"):
+                        with _phase("checkpoint", droot, "checkpoint"):
                             ckpt.save(it1, state, overwrite=True)
                         last_saved = it1
                     except Exception as e:
                         log_fn(f"periodic checkpoint at {it1} failed after "
                                f"retries ({type(e).__name__}: {e}); "
                                "continuing")
+                if droot is not None:
+                    droot.end()
     if ckpt is not None:
         if not report.preempted and train_cfg.iters != last_saved:
             ckpt.save(train_cfg.iters, state, force=True, overwrite=True)
@@ -533,6 +568,14 @@ def _run_elastic_loop(controller, step_fn, state, batches,
     report.start_step = start_step
     report.resilience = stats if stats is not None else ResilienceStats()
     spans = Spans()
+    tracer = Tracer(telemetry.events if telemetry is not None else None,
+                    phases=spans)
+
+    def _phase(name: str, parent, span_name: str):
+        if parent is not None:
+            return tracer.span(span_name, parent=parent.ctx, phase=name)
+        return spans(name)
+
     K = max(1, steps_per_dispatch)
     last_event_t = time.perf_counter()
     last_event_it = start_step - 1
@@ -556,10 +599,10 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                     loss_sink(i, v)
         pending.clear()
 
-    def _window(it0, it1):
+    def _window(it0, it1, parent=None):
         # Reads n_data/batches from the enclosing frame so a recovery's
         # rebinding re-points it at the survivors' stream automatically.
-        with spans("data"):
+        with _phase("data", parent, "stage"):
             return np.stack([
                 next(batches).reshape(n_data * train_cfg.batch_size,
                                       train_cfg.seq_len)
@@ -599,18 +642,23 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                 _force_save(edge)
                 break
             it0, it1 = edge, min(train_cfg.iters, (edge // K + 1) * K)
+            droot = (tracer.start("dispatch", trace="train", it=it0,
+                                  steps=it1 - it0, phase=False)
+                     if telemetry is not None else None)
             if staged is not None and staged[0] == it0:
                 window = staged[1]
             else:
-                window = _window(it0, it1)
+                window = _window(it0, it1, droot)
             staged = None
             t_iter = time.perf_counter()
             this_dispatch, dispatch_idx = dispatch_idx, dispatch_idx + 1
             try:
-                with spans("dispatch"):
+                with _phase("dispatch", droot, "compute"):
                     state, losses = step_fn(state,
                                             window_shard_fn(window))
             except ReplicaLossError as err:
+                if droot is not None:
+                    droot.end(replica_loss=True)
                 with spans("recover"):
                     # Drain: settle in-flight work AND keep the host
                     # copies — the device arrays belong to the dead
@@ -655,7 +703,7 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                 # this one (same overlap as the non-elastic chunked loop);
                 # a recovery discards it — wrong width, wrong stream.
                 nxt = min(train_cfg.iters, (it1 // K + 1) * K)
-                staged = (it1, _window(it1, nxt))
+                staged = (it1, _window(it1, nxt, droot))
             if log_every:
                 for i in range(it0, it1):
                     if i % log_every == 0:
@@ -700,18 +748,21 @@ def _run_elastic_loop(controller, step_fn, state, batches,
             controller.note_edge(it1, state)   # last-good mirror refresh
             if (it1 - last_flush_edge >= sink_every
                     or it1 == train_cfg.iters):
-                _flush_losses()
+                with _phase("sink", droot, "sink"):
+                    _flush_losses()
                 last_flush_edge = it1
             if ckpt is not None and (it1 // checkpoint_every
                                      ) > (it0 // checkpoint_every):
                 try:
-                    with spans("checkpoint"):
+                    with _phase("checkpoint", droot, "checkpoint"):
                         ckpt.save(it1, state, overwrite=True)
                     last_saved = it1
                 except Exception as e:
                     log_fn(f"periodic checkpoint at {it1} failed after "
                            f"retries ({type(e).__name__}: {e}); "
                            "continuing")
+            if droot is not None:
+                droot.end()
             edge = it1
     if ckpt is not None:
         if not report.preempted and train_cfg.iters != last_saved:

@@ -1,16 +1,16 @@
-"""Tracing, profiling, and result persistence.
+"""Tracing compatibility shims + CSV result persistence.
 
-The reference's observability is hand-rolled perf_counter spans accumulated
-into RunResult phases (reference: lab/tutorial_1a/hfl_complete.py:350-358,
-369-371) plus shell-level `$SECONDS` prints (homework_1_b1.sh:3,13) and CSV
-dumps from notebooks (lab/hw03/Tea_Pula_03.ipynb cell 11). This module is
-the framework equivalent, plus the TPU-native layer the reference lacks:
-`jax.profiler` device traces viewable in TensorBoard/Perfetto.
+The tracing/profiling half of this module moved to
+``ddl25spring_tpu/telemetry/trace.py`` (the ISSUE-8 span layer): ``Spans``,
+``StepTimer`` and ``device_trace`` are re-exported here unchanged so
+existing imports keep working, but there is now ONE tracing path — the
+span Tracer feeds the same ``Spans`` accumulators the registry absorbs,
+and ``device_trace`` additionally bridges host spans onto the XLA profiler
+timeline. New code should import from ``telemetry.trace`` directly.
 
-- ``Spans``: named wall-clock accumulators (setup/update/aggregate phases).
-- ``device_trace``: context manager around jax.profiler.trace.
-- ``StepTimer``: per-step timing with proper block_until_ready semantics —
-  async dispatch makes naive perf_counter spans lie on TPU.
+What still lives here is result persistence:
+
+- ``atomic_write_csv``: temp-file + ``os.replace`` CSV rewrite.
 - ``ResultSink``: append experiment records (RunResult or dicts) to CSV.
 """
 
@@ -20,108 +20,12 @@ import contextlib
 import csv
 import os
 import threading
-import time
-from collections import defaultdict
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, List, Optional
 
-import jax
+from ..telemetry.trace import Spans, StepTimer, device_trace  # noqa: F401
 
-
-class Spans:
-    """Named wall-clock accumulators, the RunResult phase-accounting helper.
-
-    Thread-safe: a watchdog/monitoring thread and the training thread may
-    accumulate into one instance concurrently (the lock covers the
-    read-modify-write of the accumulators, not the timed block itself).
-
-    >>> spans = Spans()
-    >>> with spans("update"):
-    ...     do_work()
-    >>> spans.total("update")
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._acc: Dict[str, float] = defaultdict(float)
-        self._count: Dict[str, int] = defaultdict(int)
-
-    @contextlib.contextmanager
-    def __call__(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self._acc[name] += dt
-                self._count[name] += 1
-
-    def total(self, name: str) -> float:
-        with self._lock:
-            return self._acc[name]
-
-    def count(self, name: str) -> int:
-        with self._lock:
-            return self._count[name]
-
-    def as_dict(self) -> Dict[str, float]:
-        with self._lock:
-            return dict(self._acc)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._acc.clear()
-            self._count.clear()
-
-
-@contextlib.contextmanager
-def device_trace(log_dir: str) -> Iterator[None]:
-    """jax.profiler device trace (XLA ops, HBM, ICI) → TensorBoard-readable
-    trace in ``log_dir``. The TPU-native upgrade of the reference's
-    perf_counter-only accounting (SURVEY.md §5.1)."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-class StepTimer:
-    """Per-step timing that is honest under async dispatch: ``tick`` blocks
-    on the step's outputs before reading the clock.
-
-    ``tick()`` before ``start()`` raises instead of silently recording a
-    0.0 step (the old behavior poisoned means with zeros — percentile
-    consumers in telemetry.MetricsRegistry would inherit the lie).
-    Thread-safe for the same reason as Spans."""
-
-    def __init__(self):
-        self.times: List[float] = []
-        self._t0: Optional[float] = None
-        self._lock = threading.Lock()
-
-    def start(self) -> None:
-        with self._lock:
-            self._t0 = time.perf_counter()
-
-    def tick(self, *outputs) -> float:
-        for out in outputs:
-            jax.block_until_ready(out)
-        now = time.perf_counter()
-        with self._lock:
-            if self._t0 is None:
-                raise RuntimeError(
-                    "StepTimer.tick() before start(): the interval has no "
-                    "beginning — call start() once before the timed loop")
-            dt = now - self._t0
-            self.times.append(dt)
-            self._t0 = now
-        return dt
-
-    @property
-    def mean(self) -> float:
-        with self._lock:
-            return sum(self.times) / max(len(self.times), 1)
+__all__ = ["Spans", "StepTimer", "device_trace", "atomic_write_csv",
+           "ResultSink"]
 
 
 def atomic_write_csv(path: str, fieldnames: List[str],

@@ -1,0 +1,168 @@
+"""Perf-trajectory comparator over committed BENCH_r*.json headlines.
+
+The repo commits one ``BENCH_rNN.json`` per growth round (the driver's
+wrapper: ``{"parsed": {headline row}, "tail": <bench.py stdout>, ...}``)
+plus ``BASELINE.json``; tier1.yml additionally produces a per-PR
+``bench-headline.json`` (raw ``bench.py`` stdout in DDL25_BENCH_QUICK
+mode). This tool — pure stdlib, no jax — reads any mix of those formats,
+prints the trajectory per (metric, platform, variant) group, and exits
+nonzero when the newest comparable row regresses more than
+``--max-regression`` percent against the best committed row of the SAME
+platform tag: CPU-fallback numbers must never be judged against a TPU
+row (the committed history mixes both — see ROADMAP "Perf trajectory").
+
+``--warn-only`` (how tier1.yml runs it, over the reduced bench smoke)
+prints the verdict but always exits 0: the QUICK-mode smoke is noisy by
+design, so CI gets visibility without a flaky gate; the strict mode is
+for hardware rounds.
+
+Example:
+    python -m experiments.bench_compare --candidate bench-headline.json \\
+        --max-regression 20 --warn-only
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def parse_rows(path: str) -> List[Dict[str, Any]]:
+    """Headline rows from one file, tolerating all three shapes: the
+    driver wrapper (``parsed``, plus any JSON lines in ``tail``), raw
+    bench.py stdout (human lines interleaved with JSON rows), or a bare
+    row object. A row is any JSON object with ``metric`` and a numeric
+    ``value``."""
+    with open(path) as f:
+        text = f.read()
+    rows: List[Dict[str, Any]] = []
+
+    def _add(obj):
+        if (isinstance(obj, dict) and "metric" in obj
+                and isinstance(obj.get("value"), (int, float))):
+            rows.append(obj)
+
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        _add(doc)
+        _add(doc.get("parsed"))
+        text = doc.get("tail") or ""
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                _add(json.loads(line))
+            except ValueError:
+                pass
+    # De-dup (the wrapper's parsed row usually re-appears in its tail).
+    seen, out = set(), []
+    for r in rows:
+        key = json.dumps(r, sort_keys=True)
+        if key not in seen:
+            seen.add(key)
+            out.append(r)
+    return out
+
+
+def row_key(row: Dict[str, Any]) -> Tuple[str, str, str]:
+    """Comparability key: rows measured on different platforms (or bench
+    variants) are different experiments, not a trajectory."""
+    return (str(row.get("metric")), str(row.get("platform")),
+            str(row.get("variant")))
+
+
+def compare(files: List[str], candidate: Optional[str],
+            max_regression_pct: float) -> Tuple[List[str], List[str]]:
+    """Returns (report lines, regression messages). Regressions are
+    judged candidate-vs-best-committed per key; with no candidate, the
+    newest committed file is judged against the best of the older ones."""
+    history: Dict[Tuple[str, str, str], List[Tuple[str, float]]] = {}
+    ordered = sorted(files)
+    for path in ordered:
+        for row in parse_rows(path):
+            history.setdefault(row_key(row), []).append(
+                (os.path.basename(path), float(row["value"])))
+    cand_rows: Dict[Tuple[str, str, str], Tuple[str, float]] = {}
+    if candidate:
+        for row in parse_rows(candidate):
+            cand_rows[row_key(row)] = (os.path.basename(candidate),
+                                       float(row["value"]))
+
+    lines, regressions = [], []
+    keys = sorted(set(history) | set(cand_rows))
+    for key in keys:
+        metric, platform, variant = key
+        lines.append(f"{metric} [{platform} / {variant}]")
+        traj = history.get(key, [])
+        prev = None
+        for name, value in traj:
+            delta = ("" if prev in (None, 0)
+                     else f"  ({100 * (value - prev) / prev:+.1f}%)")
+            lines.append(f"  {name:24s} {value:>14,.1f}{delta}")
+            prev = value
+        judged = cand_rows.get(key)
+        baseline_pool = traj
+        if judged is None and len(traj) >= 2:
+            judged, baseline_pool = traj[-1], traj[:-1]
+        if judged is not None and baseline_pool:
+            best_name, best = max(baseline_pool, key=lambda nv: nv[1])
+            name, value = judged
+            delta_pct = 100 * (value - best) / best if best else 0.0
+            verdict = "ok"
+            if delta_pct < -max_regression_pct:
+                verdict = "REGRESSION"
+                regressions.append(
+                    f"{metric} [{platform} / {variant}]: {name} = "
+                    f"{value:,.1f} is {-delta_pct:.1f}% below best "
+                    f"committed {best:,.1f} ({best_name}) — budget "
+                    f"{max_regression_pct:.0f}%")
+            lines.append(f"  {name:24s} {value:>14,.1f}  "
+                         f"({delta_pct:+.1f}% vs best {best_name}) "
+                         f"[{verdict}]")
+        elif judged is not None:
+            name, value = judged
+            lines.append(f"  {name:24s} {value:>14,.1f}  "
+                         "(no comparable committed row — new "
+                         "platform/variant, nothing to judge against)")
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("files", nargs="*",
+                    help="committed bench JSONs (default: BENCH_r*.json "
+                         "in the repo root / cwd)")
+    ap.add_argument("--candidate", default=None,
+                    help="the row under judgment (e.g. the CI smoke's "
+                         "bench-headline.json)")
+    ap.add_argument("--max-regression", type=float, default=20.0,
+                    help="tolerated drop (percent) vs the best committed "
+                         "same-platform row")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="print the verdict but always exit 0 (CI smoke "
+                         "mode: QUICK-bench noise must not gate merges)")
+    a = ap.parse_args(argv)
+
+    files = a.files or sorted(glob.glob("BENCH_r*.json"))
+    if not files and not a.candidate:
+        print("no BENCH_r*.json found and no --candidate given",
+              file=sys.stderr)
+        return 2
+    lines, regressions = compare(files, a.candidate, a.max_regression)
+    print("\n".join(lines) if lines else "no comparable rows found")
+    if regressions:
+        for r in regressions:
+            print(f"REGRESSION: {r}", file=sys.stderr)
+        return 0 if a.warn_only else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

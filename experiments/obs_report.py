@@ -25,6 +25,7 @@ import time
 from ddl25spring_tpu.telemetry.events import iter_runs, read_events
 from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
 from ddl25spring_tpu.telemetry.registry import percentile
+from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
 
 
 def _fmt_bytes(n: float) -> str:
@@ -47,8 +48,23 @@ def _fmt_num(v) -> str:
     return f"{v:.4f}" if isinstance(v, (int, float)) else str(v)
 
 
+def _print_violation(e: dict) -> None:
+    print(f"  {e.get('slo', '?'):20s} "
+          f"{_fmt_num(e.get('value'))} vs threshold "
+          f"{_fmt_num(e.get('threshold'))} "
+          f"(window {_fmt_num(e.get('window_s'))}s)")
+
+
 def report_run(events: list, heartbeat_path: str = None) -> None:
     """Print the report for ONE run_id's event list."""
+    if events and all(e.get("type") == "slo_violation" for e in events):
+        # A sidecar slo_monitor appends its violations under its OWN
+        # run_id (iter_runs keeps writers apart); render them as the
+        # monitor's verdict on the stream, not as a crashed run.
+        _section(f"slo violations (monitor {events[0].get('run_id')})")
+        for e in events:
+            _print_violation(e)
+        return
     by_type = {}
     for e in events:
         # .get: non-strict mode keeps parseable-but-typeless lines; the
@@ -137,6 +153,57 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                   if isinstance(e.get("blocks_in_use"), int)]
         if blocks:
             print(f"peak blocks in use: {max(blocks)}")
+
+    spans = by_type.get("span", [])
+    if spans:
+        # Traces section (schema v4 span events, telemetry/trace.py): the
+        # causal structure behind the flat percentiles above. The
+        # self-check line is the layer auditing itself — orphans (a span
+        # naming a parent the stream never closed) and imbalance
+        # (children outlasting their parent) are propagation bugs, and a
+        # report that silently rendered them would hide exactly the class
+        # of defect tracing exists to expose.
+        _section("traces")
+        trees = trace_trees(events)
+        checks = {tid: tree_check(t) for tid, t in trees.items()}
+        orphans = sum(c["orphans"] for c in checks.values())
+        imbalanced = sum(c["imbalanced"] for c in checks.values())
+        print(f"spans: {len(spans)}   traces: {len(trees)}   "
+              f"self-check: {orphans} orphaned, {imbalanced} imbalanced"
+              + ("" if not (orphans or imbalanced) else "   <-- BAD"))
+        # Per-request breakdown over traces rooted in a single "request"
+        # span (the serving trees; the train/fleet traces have per-
+        # dispatch/per-round roots and are better read in Perfetto).
+        reqs = {tid: t["roots"][0] for tid, t in trees.items()
+                if len(t["roots"]) == 1
+                and t["roots"][0].get("name") == "request"}
+        if reqs:
+            durs = sorted((r.get("dur_ns", 0), tid)
+                          for tid, r in reqs.items())
+            total_ms = [d / 1e6 for d, _ in durs]
+            print(f"request spans: {len(reqs)}   total: " + "  ".join(
+                f"p{q:g}={percentile(total_ms, q):.1f}ms"
+                for q in (50, 95, 99)))
+            # Critical path of the slowest p99 request: which child spans
+            # its end-to-end time actually went to.
+            p99 = percentile([d for d, _ in durs], 99)
+            dur, tid = next((d, t) for d, t in durs if d >= p99)
+            tree, root = trees[tid], reqs[tid]
+            kids = tree["children"].get(root.get("span_id"), [])
+            print(f"slowest p99 request: {tid}  "
+                  f"{dur / 1e6:.1f}ms end-to-end")
+            for k in kids:
+                pct = 100 * k.get("dur_ns", 0) / max(dur, 1)
+                n_sub = len(tree["children"].get(k.get("span_id"), []))
+                print(f"  {k.get('name', '?'):14s} "
+                      f"{k.get('dur_ns', 0) / 1e6:9.2f}ms  {pct:5.1f}%"
+                      + (f"  ({n_sub} children)" if n_sub else ""))
+
+    slo_events = by_type.get("slo_violation", [])
+    if slo_events:
+        _section("slo violations")
+        for e in slo_events:
+            _print_violation(e)
 
     if remeshes:
         _section("remesh (elastic recoveries)")

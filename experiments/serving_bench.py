@@ -104,13 +104,37 @@ def run(a) -> dict:
                            k: report.aggregates.get(k) for k in
                            ("total_tokens", "sustained_tokens_per_sec")})
         tel.close()
-        toks = read_events(tel.events_path, types=("request_token",))
+        stream = read_events(tel.events_path)
         seen = {}
-        for e in toks:
-            seen.setdefault(e["req"], []).append(e["i"])
+        for e in stream:
+            if e.get("type") == "request_token":
+                seen.setdefault(e["req"], []).append(e["i"])
         checks["stream_no_drop_no_dup"] = all(
             sorted(seen.get(r.rid, [])) == list(range(r.max_new))
             for r in workload)
+
+        # Span-tree completeness (ISSUE 8 acceptance bar): every request
+        # reconstructs into ONE rooted tree with zero orphaned spans —
+        # the scheduler's queue→prefill(+chunks)→decode→retire lifecycle
+        # propagated every context correctly. And the Chrome-trace export
+        # of the same stream must round-trip as valid JSON with one
+        # complete ("X") event per span.
+        from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
+        from experiments.trace_export import chrome_trace
+        trees = trace_trees(stream)
+        req_trees = [trees.get(r.rid) for r in workload]
+        tree_problems = []
+        for r, t in zip(workload, req_trees):
+            c = tree_check(t) if t is not None else None
+            if c is None or c["roots"] != 1 or c["orphans"] != 0:
+                tree_problems.append(r.rid)
+        checks["span_trees_complete"] = not tree_problems
+        n_spans = sum(1 for e in stream if e.get("type") == "span")
+        exported = json.loads(json.dumps(chrome_trace(stream)))
+        checks["trace_export_valid"] = (
+            isinstance(exported.get("traceEvents"), list)
+            and sum(1 for ev in exported["traceEvents"]
+                    if ev.get("ph") == "X") == n_spans > 0)
 
     # Bitwise parity vs generate() alone, on a sampled subset (each
     # distinct request shape costs one generate() compile).
@@ -153,6 +177,7 @@ def run(a) -> dict:
         "wall_s": round(wall, 3),
         "verified_bitwise": len(sample),
         "parity_mismatches": mismatches,
+        "span_tree_problems": (tree_problems if events else None),
         "aggregates": report.aggregates,
         "checks": checks,
         "ok": all(checks.values()),

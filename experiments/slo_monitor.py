@@ -1,0 +1,411 @@
+"""Live SLO monitor: tail a telemetry stream, flag rolling-window breaches.
+
+The watch-it-while-it-runs half of the observability layer (ISSUE 8): the
+event stream and obs_report explain a run after the fact; this tool reads
+the SAME stream while the run is alive and raises ``slo_violation`` events
+the moment a rolling-window objective breaks. Pure stdlib + the telemetry
+read helpers — never imports jax — so it runs as a sidecar (or inside the
+watchdog, which embeds ``SLOMonitor`` as a health signal next to the
+heartbeat).
+
+Objectives (each enabled by passing its threshold):
+- ``--ttft-p99``   p99 time-to-first-token (s) over the window
+  (``request_done.ttft_s``);
+- ``--queue-p99``  p99 queue wait (s) over the window;
+- ``--min-tps``    sustained tokens/sec floor — violated only while work
+  is OUTSTANDING (enqueued > done), so an idle server is not "stalled";
+- ``--max-skip-rate``  StepGuard skips per training step over the window
+  (``fault`` counter deltas / ``step`` event step counts);
+- ``--heartbeat-stale``  seconds since the heartbeat moved (live mode
+  reads heartbeat.json next to the stream; check mode compares the last
+  beat to the last event).
+
+Two modes:
+- **live** (default): follow the growing file (incremental reads, torn
+  final line buffered until its newline arrives — the tailer never
+  misparses a mid-write line), evaluate every ``--poll``, print and (with
+  ``--emit``, default ON live) append ``slo_violation`` events to the
+  stream — O_APPEND keeps the writer's lines and ours from interleaving,
+  and ``iter_runs`` keeps the runs apart. Stops at ``--duration``, or at
+  the stream's ``run_end`` once nothing is outstanding.
+- **--check**: replay a COMPLETE stream in event time (no wall clock),
+  evaluating once per quarter-window; nonzero exit when any objective was
+  breached — the CI mode tier1.yml runs over the serving smoke's stream.
+
+Example (the serving smoke's stream):
+    python -m experiments.slo_monitor serving-telemetry --check \\
+        --ttft-p99 5.0 --min-tps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ddl25spring_tpu.telemetry.events import EventLog, read_events
+from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
+from ddl25spring_tpu.telemetry.registry import percentile
+
+
+class StreamTailer:
+    """Incremental JSONL reader for a growing file.
+
+    Keeps a byte offset and buffers a torn final line until its newline
+    arrives — a mid-``write()`` line is never misparsed, the same
+    tolerance as ``read_events`` but without re-reading the file each
+    poll. ``from_end=True`` starts at the CURRENT end of file: the
+    watchdog monitors only what happens after it attaches, so a dead
+    run's leftovers (its never-completed request_enqueue events) cannot
+    poison a fresh monitor's outstanding-work counters. A file that
+    SHRANK is handled per mode: the default resets to 0 and re-reads (a
+    recycled dir — duplicate events are harmless to a rolling window,
+    silence about a new run is not), while ``from_end`` re-attaches at
+    the new end — the common shrink there is a relaunched writer's
+    EventLog healing a torn fragment by a few bytes, and a reset to 0
+    would replay the whole dead-run history ``from_end`` exists to
+    skip."""
+
+    def __init__(self, path: str, *, from_end: bool = False):
+        self.path = path
+        self._from_end = from_end
+        self._offset = 0
+        if from_end:
+            # Attach after the last NEWLINE, not at raw EOF: if the file
+            # currently ends in a dead writer's torn fragment, a
+            # relaunching EventLog will heal it by truncating to exactly
+            # that newline — an attach at raw EOF would then sit past the
+            # truncation point and (after the file regrows) read from the
+            # middle of a new line, losing its first event.
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    back = min(size, 1 << 16)
+                    f.seek(size - back)
+                    nl = f.read(back).rfind(b"\n")
+                    self._offset = size - back + nl + 1 if nl != -1 else 0
+            except OSError:
+                pass                      # no file yet: start at 0
+        self._buf = b""
+
+    def poll(self) -> List[Dict[str, Any]]:
+        try:
+            size = os.stat(self.path).st_size
+            if size < self._offset:
+                self._offset = size if self._from_end else 0
+                self._buf = b""
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+        except OSError:
+            return []
+        if not data:
+            return []
+        self._offset += len(data)
+        lines = (self._buf + data).split(b"\n")
+        self._buf = lines.pop()        # b"" when data ended in a newline
+        events = []
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue               # sealed fragment / corruption: skip
+            if isinstance(e, dict):
+                events.append(e)
+        return events
+
+
+@dataclass
+class SLOConfig:
+    """Thresholds; ``None`` disables an objective."""
+    window_s: float = 30.0
+    ttft_p99_s: Optional[float] = None
+    queue_p99_s: Optional[float] = None
+    min_tokens_per_sec: Optional[float] = None
+    max_skip_rate: Optional[float] = None
+    heartbeat_stale_s: Optional[float] = None
+
+
+class SLOMonitor:
+    """Rolling-window SLO state machine: ``feed`` events (any order of
+    types; timestamps from their ``t`` field), then ``evaluate(now)``.
+
+    A violation is reported on the ok→breached TRANSITION per objective
+    (and again if it re-breaches after recovering), not on every poll —
+    a sustained breach is one incident, not one event per second. The
+    currently-breached set is ``active``; every incident ever seen is in
+    ``violations``."""
+
+    def __init__(self, cfg: SLOConfig, emit: Optional[EventLog] = None):
+        self.cfg = cfg
+        self.emit = emit
+        self._ttft: deque = deque()     # (t, seconds)
+        self._wait: deque = deque()     # (t, seconds)
+        self._tokens: deque = deque()   # (t, count)
+        self._token_events = False      # stream has per-token granularity
+        self.first_token_t: Optional[float] = None
+        self._skips: deque = deque()    # (t, count)
+        self._steps: deque = deque()    # (t, count)
+        self.enqueued = 0
+        self.done = 0
+        self.run_ended = False
+        self.first_event_t: Optional[float] = None
+        self.last_event_t: Optional[float] = None
+        self.active: Dict[str, dict] = {}
+        self.violations: List[dict] = []
+
+    def feed(self, events: List[Dict[str, Any]]) -> None:
+        for e in events:
+            t = e.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            self.first_event_t = (t if self.first_event_t is None
+                                  else min(self.first_event_t, t))
+            self.last_event_t = (t if self.last_event_t is None
+                                 else max(self.last_event_t, t))
+            etype = e.get("type")
+            if etype == "request_enqueue":
+                self.enqueued += 1
+            elif etype == "request_token":
+                if not self._token_events:
+                    # First per-token event: from here tokens are counted
+                    # at token granularity, never ALSO at done granularity
+                    # (a request's tokens always precede its done, so no
+                    # done was ever counted before this flips).
+                    self._token_events = True
+                    self._tokens.clear()
+                self._tokens.append((t, 1))
+                if self.first_token_t is None or t < self.first_token_t:
+                    self.first_token_t = t
+            elif etype == "request_done":
+                self.done += 1
+                if not self._token_events and isinstance(e.get("tokens"),
+                                                         int):
+                    # Streams recorded with Scheduler(token_events=False)
+                    # still carry throughput at completion granularity —
+                    # without this, the tok/s floor would read a healthy
+                    # quiet-stream server as permanently stalled.
+                    self._tokens.append((t, e["tokens"]))
+                    if self.first_token_t is None or t < self.first_token_t:
+                        self.first_token_t = t
+                if isinstance(e.get("ttft_s"), (int, float)):
+                    self._ttft.append((t, e["ttft_s"]))
+                if isinstance(e.get("queue_wait_s"), (int, float)):
+                    self._wait.append((t, e["queue_wait_s"]))
+            elif etype == "fault":
+                counters = e.get("counters") or {}
+                skips = counters.get("skipped_steps", 0)
+                if isinstance(skips, int) and skips > 0:
+                    self._skips.append((t, skips))
+            elif etype == "step":
+                steps = e.get("steps")
+                if isinstance(steps, int) and steps > 0:
+                    self._steps.append((t, steps))
+            elif etype == "run_end":
+                self.run_ended = True
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.cfg.window_s
+        for dq in (self._ttft, self._wait, self._tokens, self._skips,
+                   self._steps):
+            while dq and dq[0][0] < horizon:
+                dq.popleft()
+
+    def evaluate(self, now: float,
+                 heartbeat: Optional[dict] = None) -> List[dict]:
+        """Measure every enabled objective over [now - window, now];
+        returns the NEW violations (transitions into breach)."""
+        self._prune(now)
+        cfg = self.cfg
+        measured: Dict[str, tuple] = {}   # slo -> (value, threshold)
+        if cfg.ttft_p99_s is not None and self._ttft:
+            v = percentile([x for _, x in self._ttft], 99)
+            if v > cfg.ttft_p99_s:
+                measured["ttft_p99_s"] = (v, cfg.ttft_p99_s)
+        if cfg.queue_p99_s is not None and self._wait:
+            v = percentile([x for _, x in self._wait], 99)
+            if v > cfg.queue_p99_s:
+                measured["queue_p99_s"] = (v, cfg.queue_p99_s)
+        if (cfg.min_tokens_per_sec is not None
+                and self.enqueued > self.done):
+            # Outstanding work is what makes a low rate a STALL rather
+            # than an idle lull. Two regimes:
+            # - no token has EVER arrived: that is startup (XLA compile),
+            #   not a throughput deficit — grant one full window from the
+            #   stream's birth before calling it a stall (a compile
+            #   longer than the window is indistinguishable from one);
+            # - tokens have flowed: judge the floor over the OBSERVED
+            #   span since the first token, capped at the window — a
+            #   partial window must not deflate a healthy rate, and the
+            #   pre-first-token compile gap must not count against it.
+            if self.first_token_t is None:
+                if (self.first_event_t is not None
+                        and now - self.first_event_t > cfg.window_s):
+                    measured["tokens_per_sec"] = (0.0,
+                                                  cfg.min_tokens_per_sec)
+            else:
+                span = min(cfg.window_s,
+                           max(now - self.first_token_t, 1e-9))
+                v = sum(n for _, n in self._tokens) / span
+                if v < cfg.min_tokens_per_sec:
+                    measured["tokens_per_sec"] = (v, cfg.min_tokens_per_sec)
+        if cfg.max_skip_rate is not None and self._skips:
+            steps = sum(n for _, n in self._steps)
+            skips = sum(n for _, n in self._skips)
+            v = skips / max(steps, skips)    # skipped steps consumed data
+            if v > cfg.max_skip_rate:
+                measured["guard_skip_rate"] = (v, cfg.max_skip_rate)
+        if cfg.heartbeat_stale_s is not None and heartbeat is not None \
+                and isinstance(heartbeat.get("time"), (int, float)):
+            v = now - heartbeat["time"]
+            if v > cfg.heartbeat_stale_s:
+                measured["heartbeat_stale_s"] = (v, cfg.heartbeat_stale_s)
+
+        fresh = []
+        for slo, (value, threshold) in measured.items():
+            record = {"slo": slo, "value": value, "threshold": threshold,
+                      "window_s": cfg.window_s, "t_eval": now}
+            if slo not in self.active:
+                fresh.append(record)
+                self.violations.append(record)
+                if self.emit is not None:
+                    self.emit.slo_violation(**record)
+            self.active[slo] = record
+        for slo in list(self.active):
+            if slo not in measured:
+                del self.active[slo]     # recovered; a re-breach re-fires
+        return fresh
+
+
+def check_stream(events: List[Dict[str, Any]], cfg: SLOConfig,
+                 heartbeat: Optional[dict] = None,
+                 emit: Optional[EventLog] = None) -> List[dict]:
+    """Offline replay for ``--check``: walk the stream in event time,
+    evaluating every quarter-window and once at the end — a stream that
+    goes SILENT mid-run (the stall case) is caught at that final
+    evaluation, whose ``now`` is the heartbeat's last beat when that is
+    newer than the last event (a dead writer's stream ends, its staleness
+    does not)."""
+    monitor = SLOMonitor(cfg, emit=emit)
+    events = sorted(events, key=lambda e: e.get("t", 0.0))
+    last_eval = None
+    for e in events:
+        monitor.feed([e])
+        t = e.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        if last_eval is None:
+            last_eval = t
+        elif t - last_eval >= cfg.window_s / 4:
+            monitor.evaluate(t, heartbeat)
+            last_eval = t
+    if monitor.last_event_t is not None:
+        end = monitor.last_event_t
+        if heartbeat is not None and isinstance(heartbeat.get("time"),
+                                                (int, float)):
+            end = max(end, heartbeat["time"])
+        monitor.evaluate(end, heartbeat)
+    return monitor.violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="telemetry run dir (containing "
+                                 "events.jsonl) or an events.jsonl path")
+    ap.add_argument("--check", action="store_true",
+                    help="replay the complete stream in event time; exit "
+                         "1 if any objective was breached")
+    ap.add_argument("--window", type=float, default=30.0,
+                    help="rolling window seconds")
+    ap.add_argument("--ttft-p99", type=float, default=None,
+                    help="p99 TTFT ceiling (s)")
+    ap.add_argument("--queue-p99", type=float, default=None,
+                    help="p99 queue wait ceiling (s)")
+    ap.add_argument("--min-tps", type=float, default=None,
+                    help="sustained tokens/sec floor while work is "
+                         "outstanding")
+    ap.add_argument("--max-skip-rate", type=float, default=None,
+                    help="StepGuard skipped-steps / steps ceiling")
+    ap.add_argument("--heartbeat-stale", type=float, default=None,
+                    help="heartbeat age ceiling (s)")
+    ap.add_argument("--poll", type=float, default=2.0,
+                    help="live mode: seconds between evaluations")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="live mode: stop after this many seconds")
+    ap.add_argument("--emit", dest="emit", action="store_true",
+                    default=None,
+                    help="append slo_violation events to the stream "
+                         "(default: on live, off under --check)")
+    ap.add_argument("--no-emit", dest="emit", action="store_false")
+    ap.add_argument("--out", default=None,
+                    help="write the violation list as JSON here")
+    a = ap.parse_args(argv)
+
+    if os.path.isdir(a.path):
+        events_path = os.path.join(a.path, "events.jsonl")
+        heartbeat_path = os.path.join(a.path, "heartbeat.json")
+    else:
+        events_path = a.path
+        heartbeat_path = os.path.join(os.path.dirname(a.path) or ".",
+                                      "heartbeat.json")
+    cfg = SLOConfig(window_s=a.window, ttft_p99_s=a.ttft_p99,
+                    queue_p99_s=a.queue_p99,
+                    min_tokens_per_sec=a.min_tps,
+                    max_skip_rate=a.max_skip_rate,
+                    heartbeat_stale_s=a.heartbeat_stale)
+    emit_default = not a.check
+    emit = a.emit if a.emit is not None else emit_default
+    # heal=False: we are a SIDECAR on a possibly-LIVE stream — append
+    # only, never truncate what might be another writer's in-flight line.
+    log = (EventLog(events_path, run_id=f"slo-{os.getpid()}", heal=False)
+           if emit else None)
+
+    def _hb():
+        return (read_heartbeat(heartbeat_path)
+                if os.path.exists(heartbeat_path) else None)
+
+    if a.check:
+        if not os.path.exists(events_path):
+            print(f"no event stream at {events_path}", file=sys.stderr)
+            return 2
+        violations = check_stream(read_events(events_path), cfg,
+                                  heartbeat=_hb(), emit=log)
+    else:
+        tailer = StreamTailer(events_path)
+        monitor = SLOMonitor(cfg, emit=log)
+        t0 = time.time()
+        while True:
+            monitor.feed(tailer.poll())
+            for v in monitor.evaluate(time.time(), _hb()):
+                print(f"[slo] VIOLATION {v['slo']}: {v['value']:.4g} vs "
+                      f"threshold {v['threshold']:.4g} "
+                      f"(window {v['window_s']:.0f}s)", flush=True)
+            if a.duration is not None and time.time() - t0 >= a.duration:
+                break
+            if monitor.run_ended and monitor.enqueued <= monitor.done:
+                break
+            time.sleep(a.poll)
+        violations = monitor.violations
+    if log is not None:
+        log.close()
+
+    summary = {"events_path": events_path, "window_s": cfg.window_s,
+               "violations": violations, "ok": not violations}
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(summary, f)
+            f.write("\n")
+    print(json.dumps(summary))
+    return 1 if (a.check and violations) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Export a telemetry event stream as Chrome trace-event JSON.
+
+The read-side bridge from the span layer (ddl25spring_tpu/telemetry/
+trace.py) to real trace viewers: feed it any telemetry ``events.jsonl``
+(or a run directory) and it writes a JSON file loadable in Perfetto
+(https://ui.perfetto.dev — drag-and-drop) or ``chrome://tracing``. Pure
+stdlib + the telemetry read helpers — never imports jax — and reuses the
+torn-line-tolerant reader, so it runs against a LIVE stream (the torn
+final line a crashed or mid-write writer leaves is dropped, same as every
+other reader).
+
+Mapping (the Chrome trace-event format's process/thread model):
+- one *process* row per ``run_id`` (relaunches sharing a telemetry dir
+  stay separate), named by a metadata event;
+- one *thread* row per ``trace_id`` — a serving request, the training
+  run's "train" trace, a fleet round — so each request's
+  queue→prefill→decode→retire tree renders as one nested timeline;
+- every closed span becomes a complete ("X") event at its tracer-clock
+  microseconds; span attributes land in ``args`` (clickable in the UI);
+- sparse diagnostic events (``fault``/``remesh``/``slo_violation``)
+  become instant ("i") markers, anchored onto the span clock via the
+  epoch-vs-span-clock offset of the run's NEAREST-in-time span (they
+  carry only epoch time; a run with no spans exports no markers).
+  Nearest, not first: the serving scheduler's span clock fast-forwards
+  through idle gaps, so one global offset would drift by the total
+  skipped idle time — the nearest span bounds the error to its own
+  window.
+
+Example (the serving smoke's telemetry):
+    python -m experiments.serving_bench --telemetry-dir /tmp/serve
+    python -m experiments.trace_export /tmp/serve --out trace.json
+    # then load trace.json in ui.perfetto.dev
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+from ddl25spring_tpu.telemetry.events import read_events
+
+# Flat events rendered as instant markers on the timeline (sparse,
+# diagnostic). Everything else flat is either covered by a span
+# (request_*, step) or not a point in time (manifest, run_end metrics).
+INSTANT_TYPES = ("fault", "remesh", "slo_violation")
+
+# Span fields that are structure, not attributes.
+_SPAN_BASE = ("schema", "run_id", "seq", "t", "type", "name", "trace_id",
+              "span_id", "parent_span_id", "start_ns", "dur_ns")
+
+
+def chrome_trace(events: List[Dict[str, Any]],
+                 instants: bool = True) -> Dict[str, Any]:
+    """Pure conversion: event list → Chrome trace-event JSON object.
+    Deterministic (ids assigned in first-seen order), so equal streams
+    give equal traces — the golden test in tests/test_telemetry.py pins
+    the exact output for a tiny stream."""
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+    out: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = []
+    # run_id -> sorted (epoch t, epoch-at-ns-zero offset) pairs, one per
+    # span event: instants anchor via the NEAREST span in epoch time
+    # (module docstring — a single global offset drifts when a tracer's
+    # clock fast-forwards through idle).
+    anchors: Dict[str, List[tuple]] = {}
+
+    def pid_of(run_id: str) -> int:
+        if run_id not in pids:
+            pids[run_id] = len(pids) + 1
+            meta.append({"ph": "M", "name": "process_name",
+                         "pid": pids[run_id], "tid": 0,
+                         "args": {"name": f"run {run_id}"}})
+        return pids[run_id]
+
+    def tid_of(run_id: str, trace_id: str) -> int:
+        key = (run_id, trace_id)
+        if key not in tids:
+            tids[key] = len([k for k in tids if k[0] == run_id]) + 1
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": pid_of(run_id), "tid": tids[key],
+                         "args": {"name": trace_id}})
+        return tids[key]
+
+    for e in events:
+        if e.get("type") != "span":
+            continue
+        run = e.get("run_id", "?")
+        start_ns = e.get("start_ns", 0)
+        dur_ns = e.get("dur_ns", 0)
+        if isinstance(e.get("t"), (int, float)):
+            # The span event is emitted AT span end: epoch t ≈ tracer
+            # clock (start+dur) ns — one calibration point per span.
+            anchors.setdefault(run, []).append(
+                (e["t"], e["t"] - (start_ns + dur_ns) / 1e9))
+        args = {k: v for k, v in e.items() if k not in _SPAN_BASE}
+        args["span_id"] = e.get("span_id")
+        if e.get("parent_span_id") is not None:
+            args["parent_span_id"] = e["parent_span_id"]
+        out.append({"ph": "X", "name": e.get("name", "?"), "cat": "span",
+                    "ts": start_ns / 1e3, "dur": dur_ns / 1e3,
+                    "pid": pid_of(run),
+                    "tid": tid_of(run, e.get("trace_id", "?")),
+                    "args": args})
+    if instants:
+        import bisect
+        for pairs in anchors.values():
+            pairs.sort()
+        for e in events:
+            etype = e.get("type")
+            run = e.get("run_id", "?")
+            if (etype not in INSTANT_TYPES or run not in anchors
+                    or not isinstance(e.get("t"), (int, float))):
+                continue
+            pairs = anchors[run]
+            i = bisect.bisect_left(pairs, (e["t"],))
+            if i > 0 and (i == len(pairs)
+                          or pairs[i][0] - e["t"] > e["t"] - pairs[i - 1][0]):
+                i -= 1                      # the nearer calibration point
+            args = {k: v for k, v in e.items()
+                    if k not in ("schema", "run_id", "seq", "t", "type")}
+            out.append({"ph": "i", "name": etype, "cat": "event", "s": "p",
+                        "ts": (e["t"] - pairs[i][1]) * 1e6,
+                        "pid": pid_of(run), "tid": 0, "args": args})
+    out.sort(key=lambda ev: (ev["pid"], ev["tid"], ev["ts"]))
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="telemetry run dir (containing "
+                                 "events.jsonl) or an events.jsonl path")
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default: stdout)")
+    ap.add_argument("--no-instants", action="store_true",
+                    help="spans only; skip fault/remesh/slo markers")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on malformed/invalid events")
+    a = ap.parse_args(argv)
+
+    events_path = (os.path.join(a.path, "events.jsonl")
+                   if os.path.isdir(a.path) else a.path)
+    if not os.path.exists(events_path):
+        print(f"no event stream at {events_path}", file=sys.stderr)
+        return 2
+    events = read_events(events_path, strict=a.strict)
+    spans = sum(1 for e in events if e.get("type") == "span")
+    if not spans:
+        print(f"{events_path}: no span events (a pre-v4 stream, or a "
+              "run without tracing) — nothing to export", file=sys.stderr)
+        return 2
+    trace = chrome_trace(events, instants=not a.no_instants)
+    text = json.dumps(trace, separators=(",", ":"))
+    if a.out:
+        with open(a.out, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    print(f"exported {spans} spans ({len(trace['traceEvents'])} trace "
+          f"events) from {events_path}"
+          + (f" -> {a.out}" if a.out else ""), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,16 @@ sink interval (and not at all for runs without a loss sink), so a healthy
 run between sink rows no longer looks stalled. Either signal moving counts
 as alive; a new pid in the heartbeat also counts (a relaunch IS life).
 
+Health signals (``--slo-events``, experiments/slo_monitor.py): point the
+watchdog at the run's telemetry ``events.jsonl`` and it tails the stream
+alongside the heartbeat — new events count as liveness, and the embedded
+rolling-window SLOMonitor (thresholds via ``--slo-*``) distinguishes a run
+that is alive-but-unhealthy from one that is merely alive: violations are
+logged as they transition into breach, and with ``--slo-grace`` seconds of
+SUSTAINED breach the run is killed and relaunched exactly like a stall —
+a serving process emitting heartbeats while its p99 TTFT burns is a
+failure the heartbeat alone can never see.
+
 Relaunches back off exponentially with deterministic jitter
 (ddl25spring_tpu/resilience/retry.py), and crash-loops are distinguished
 from stalls: a process that exits nonzero within ``--crash-window`` seconds
@@ -117,6 +127,24 @@ def main() -> int:
                     help="this many consecutive crashes -> exit "
                          f"{EXIT_CRASH_LOOP} (crash loop: the command is "
                          "broken, relaunching won't help)")
+    ap.add_argument("--slo-events", default=None,
+                    help="telemetry events.jsonl to tail: growth counts as "
+                         "liveness, and the --slo-* thresholds are "
+                         "evaluated over it as rolling-window health")
+    ap.add_argument("--slo-window", type=float, default=30.0,
+                    help="SLO rolling window (seconds)")
+    ap.add_argument("--slo-ttft-p99", type=float, default=None,
+                    help="p99 TTFT ceiling (s)")
+    ap.add_argument("--slo-queue-p99", type=float, default=None,
+                    help="p99 queue-wait ceiling (s)")
+    ap.add_argument("--slo-min-tps", type=float, default=None,
+                    help="sustained tokens/sec floor while work is "
+                         "outstanding")
+    ap.add_argument("--slo-max-skip-rate", type=float, default=None,
+                    help="StepGuard skipped-steps/steps ceiling")
+    ap.add_argument("--slo-grace", type=float, default=0.0,
+                    help="kill+relaunch after this many seconds of "
+                         "SUSTAINED SLO breach (0 = log violations only)")
     ap.add_argument("--dedupe-keys", nargs="*", default=None,
                     help="CSV columns identifying a row; dedupe the "
                          "progress file on success")
@@ -136,11 +164,32 @@ def main() -> int:
     poll_s = 30.0
     consecutive_crashes = 0
     consecutive_failures = 0  # resets when a segment makes progress
+    slo_enabled = a.slo_events is not None
+    if slo_enabled:
+        # Stdlib-only imports (slo_monitor never touches jax), deferred so
+        # plain watchdog runs don't even read the module.
+        from .slo_monitor import SLOConfig, SLOMonitor, StreamTailer
+        from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
+        slo_cfg = SLOConfig(window_s=a.slo_window,
+                            ttft_p99_s=a.slo_ttft_p99,
+                            queue_p99_s=a.slo_queue_p99,
+                            min_tokens_per_sec=a.slo_min_tps,
+                            max_skip_rate=a.slo_max_skip_rate)
     for attempt in range(a.max_restarts + 1):
         print(f"[watchdog] attempt {attempt}: {' '.join(cmd)}", flush=True)
         launched = time.time()
         proc = subprocess.Popen(cmd)
         monitor = LivenessMonitor(a.progress, a.heartbeat)
+        if slo_enabled:
+            # Fresh per attempt, attached at the stream's CURRENT end: a
+            # relaunch must not inherit the dead run's breach state, and
+            # the monitor's outstanding-work counters are cumulative — a
+            # killed run's never-completed request_enqueue events would
+            # otherwise arm the stall gate against the healthy relaunch
+            # forever (its requests complete under NEW ids).
+            tailer = StreamTailer(a.slo_events, from_end=True)
+            slo = SLOMonitor(slo_cfg)
+            first_breach = None
         last_change = time.time()
         progressed = False
         while True:
@@ -149,7 +198,31 @@ def main() -> int:
                 break
             except subprocess.TimeoutExpired:
                 pass
-            if monitor.poll():
+            moved = monitor.poll()
+            if slo_enabled:
+                fresh_events = tailer.poll()
+                if fresh_events:
+                    slo.feed(fresh_events)
+                    moved = True            # a growing stream IS liveness
+                hb = (read_heartbeat(a.heartbeat) if a.heartbeat else None)
+                for v in slo.evaluate(time.time(), hb):
+                    print(f"[watchdog] SLO VIOLATION {v['slo']}: "
+                          f"{v['value']:.4g} vs {v['threshold']:.4g} "
+                          f"(window {v['window_s']:.0f}s)", flush=True)
+                if slo.active:
+                    first_breach = first_breach or time.time()
+                    if (a.slo_grace > 0
+                            and time.time() - first_breach > a.slo_grace):
+                        print(f"[watchdog] SLOs {sorted(slo.active)} "
+                              f"breached for > {a.slo_grace:.0f}s — "
+                              f"killing pid {proc.pid}", flush=True)
+                        proc.kill()
+                        proc.wait()
+                        rc = None
+                        break
+                else:
+                    first_breach = None
+            if moved:
                 last_change = time.time()
                 progressed = True
             elif time.time() - last_change > a.stall_min * 60:

@@ -307,3 +307,59 @@ def test_synthetic_source_deterministic_and_on_demand():
     np.testing.assert_array_equal(a[0][1], b[0][0])     # client 900
     c = src.cohort(np.asarray([5]))
     np.testing.assert_array_equal(a[0][0], c[0][0])
+
+
+def test_fleet_round_span_tree_matches_tiers(setup, tmp_path):
+    """ISSUE 8: a telemetered two-tier round reassembles into the
+    round -> tier -> cohort span tree, complete (single root, zero
+    orphans) and consistent with the flat fl_cohort events — one cohort
+    span per cohort dispatch, one edge-tier span per edge, a server-tier
+    span only when the server tier actually reduced."""
+    from ddl25spring_tpu.telemetry import Telemetry
+    from ddl25spring_tpu.telemetry.events import read_events
+    from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
+    src, data, params, xt, yt, cfg = setup
+    with Telemetry(str(tmp_path / "tel")) as tel:
+        s = FleetFedAvgServer(params, apply_fn, src, xt, yt, cfg,
+                              FleetConfig(cohort_width=5, edges=2),
+                              telemetry=tel)
+        s.run(1)
+        events = read_events(tel.events_path, strict=True)
+    t = trace_trees(events)["fleet"]
+    assert tree_check(t) == {"roots": 1, "orphans": 0, "imbalanced": 0}
+    root = t["roots"][0]
+    assert root["name"] == "fl_round" and root["round"] == 0
+    tiers = t["children"][root["span_id"]]
+    edge_tiers = [k for k in tiers if k.get("tier") == "edge"]
+    server_tiers = [k for k in tiers if k.get("tier") == "server"]
+    assert len(edge_tiers) == 2 and len(server_tiers) == 1
+    cohort_events = [e for e in events if e.get("type") == "fl_cohort"]
+    cohort_spans = [k for et in edge_tiers
+                    for k in t["children"].get(et["span_id"], [])]
+    assert all(k["name"] == "cohort" for k in cohort_spans)
+    assert len(cohort_spans) == len(cohort_events) > 0
+    # Per-edge cohort counts line up with the flat events' accounting.
+    for e, et in enumerate(edge_tiers):
+        flat = [ev for ev in cohort_events if ev.get("edge") == e]
+        kids = t["children"].get(et["span_id"], [])
+        assert [k["cohort"] for k in kids] == [ev["cohort"] for ev in flat]
+        assert [k["clients"] for k in kids] == [ev["clients"]
+                                                for ev in flat]
+
+
+def test_fleet_flat_round_emits_no_server_tier_span(setup, tmp_path):
+    """edges=1 IS the flat path: no server tier runs, so no server-tier
+    span may claim otherwise."""
+    from ddl25spring_tpu.telemetry import Telemetry
+    from ddl25spring_tpu.telemetry.events import read_events
+    from ddl25spring_tpu.telemetry.trace import trace_trees
+    src, data, params, xt, yt, cfg = setup
+    with Telemetry(str(tmp_path / "tel")) as tel:
+        s = FleetFedAvgServer(params, apply_fn, src, xt, yt, cfg,
+                              FleetConfig(cohort_width=5),
+                              telemetry=tel)
+        s.run(1)
+        events = read_events(tel.events_path, strict=True)
+    t = trace_trees(events)["fleet"]
+    tiers = t["children"][t["roots"][0]["span_id"]]
+    assert [k.get("tier") for k in tiers] == ["edge"]

@@ -321,3 +321,46 @@ def test_synthetic_workload_deterministic():
                            vocab_size=50)
     assert a != c
     assert all(x.arrival < y.arrival for x, y in zip(a, a[1:]))
+
+
+def test_request_span_trees_complete(params, tmp_path):
+    """ISSUE 8: every request reconstructs into ONE rooted span tree with
+    zero orphans — queue -> prefill (with per-tick prefill_chunk
+    children) -> decode -> retire, all strict-valid schema v4, and the
+    span durations agree with the request_done latency fields (same
+    clock by construction)."""
+    from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
+    wl = synthetic_workload(seed=5, n_requests=6, rate_rps=100.0,
+                            vocab_size=CFG.vocab_size, prompt_lens=(4, 9),
+                            max_news=(1, 4), temperatures=(0.0,))
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="srv") as log:
+        run_serving(params, CFG, PAGED, wl, num_slots=3, prefill_chunk=4,
+                    events=log)
+    events = read_events(path, strict=True)
+    trees = trace_trees(events)
+    for r in wl:
+        t = trees[r.rid]
+        assert tree_check(t) == {"roots": 1, "orphans": 0,
+                                 "imbalanced": 0}, r.rid
+        root = t["roots"][0]
+        assert root["name"] == "request" and root["tokens"] == r.max_new
+        kids = t["children"][root["span_id"]]
+        names = [k["name"] for k in kids]
+        assert names[0] == "queue" and names[-1] == "retire"
+        assert "prefill" in names and "decode" in names
+        # (A one-token request's decode span exists but covers zero
+        # decode boundaries: first == done in one engine event, so it
+        # opens and closes within the same tick's bookkeeping.)
+        prefill = next(k for k in kids if k["name"] == "prefill")
+        chunks = t["children"].get(prefill["span_id"], [])
+        assert len(chunks) == prefill["chunks"] >= 1
+        assert [c["chunk"] for c in chunks] == list(range(len(chunks)))
+    # Cross-check against the flat lifecycle: the queue span's duration
+    # IS the queue wait (one clock, two views).
+    done = {e["req"]: e for e in events if e.get("type") == "request_done"}
+    for r in wl:
+        queue = next(k for k in trees[r.rid]["children"][
+            trees[r.rid]["roots"][0]["span_id"]] if k["name"] == "queue")
+        assert queue["dur_ns"] / 1e9 == pytest.approx(
+            done[r.rid]["queue_wait_s"], abs=5e-3)

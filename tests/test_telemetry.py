@@ -199,12 +199,33 @@ def test_validate_event_contract():
     assert validate_event({**base, "type": "step", "it": 3}) == []
     # Per-type required fields.
     assert validate_event({**base, "type": "step"}) != []
-    # Unknown types are forward-compatible, not errors.
-    assert validate_event({**base, "type": "novel_event"}) == []
+    # The type set is CLOSED per schema version: an unknown type at/below
+    # the reader's version is a typo, not forward compat — and the problem
+    # names it.
+    problems = validate_event({**base, "type": "novel_event"})
+    assert problems and "novel_event" in problems[0]
     # A FUTURE schema version is a problem; missing base fields are too.
     assert validate_event({**base, "schema": SCHEMA_VERSION + 1,
                            "type": "step", "it": 0}) != []
     assert validate_event({"type": "step", "it": 0}) != []
+
+
+def test_validate_event_forward_version_names_offender():
+    """A vN+1 writer against this reader used to fail with only 'schema N+1
+    is newer' — the message must now NAME the event type that carried the
+    future version, and an unknown type riding a future schema must be
+    reported as the version skew it is, not double-flagged as a typo."""
+    base = {"run_id": "r", "seq": 1, "t": 0.0}
+    problems = validate_event({**base, "schema": SCHEMA_VERSION + 1,
+                               "type": "hologram"})
+    assert len(problems) == 1
+    assert "hologram" in problems[0]
+    assert str(SCHEMA_VERSION + 1) in problems[0]
+    # Same unknown type AT the reader's version: flagged as unknown, with
+    # the version it claimed.
+    problems = validate_event({**base, "schema": SCHEMA_VERSION,
+                               "type": "hologram"})
+    assert len(problems) == 1 and "unknown event type" in problems[0]
 
 
 def test_request_event_emitters_roundtrip(tmp_path):
@@ -676,3 +697,403 @@ def test_fl_server_emits_round_events(tmp_path):
     end = [e for e in events if e["type"] == "run_end"][-1]
     assert end["final_accuracy"] == result.test_accuracy[-1]
     assert read_heartbeat(tel.heartbeat_path)["seq"] == 2
+
+
+# ------------------------------------------------- span layer (schema v4)
+
+def test_span_context_propagation_roundtrip(tmp_path):
+    """The tentpole contract: explicit parent propagation reconstructs the
+    exact tree — trace/span/parent ids round-trip through the stream
+    (strict-valid under schema v4), SpanContext survives as_dict/from_dict
+    across a process boundary, and the reassembled tree has one root,
+    zero orphans, children in start order."""
+    from ddl25spring_tpu.telemetry.trace import (SpanContext, Tracer,
+                                                 trace_trees, tree_check)
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="t") as log:
+        tr = Tracer(log)
+        with tr.span("round", trace="round-0", round=0) as root:
+            # Simulate crossing a process/function boundary: the context
+            # travels as a dict, not an object.
+            wire = root.ctx.as_dict()
+            handed = SpanContext.from_dict(wire)
+            assert handed == root.ctx
+            with tr.span("tier", parent=handed, tier="edge") as tier:
+                with tr.span("cohort", parent=tier.ctx, cohort=0):
+                    pass
+                with tr.span("cohort", parent=tier.ctx, cohort=1):
+                    pass
+    events = read_events(path, strict=True)       # v4-valid
+    assert all(e["type"] == "span" for e in events)
+    trees = trace_trees(events)
+    assert set(trees) == {"round-0"}
+    t = trees["round-0"]
+    assert tree_check(t) == {"roots": 1, "orphans": 0, "imbalanced": 0}
+    root_ev = t["roots"][0]
+    assert root_ev["name"] == "round" and root_ev["round"] == 0
+    (tier_ev,) = t["children"][root_ev["span_id"]]
+    cohorts = t["children"][tier_ev["span_id"]]
+    assert [c["cohort"] for c in cohorts] == [0, 1]   # start-ns order
+    # Parenting is by id, not nesting order of emission (children emit
+    # BEFORE their parent closes).
+    assert [e["name"] for e in events] == ["cohort", "cohort", "tier",
+                                           "round"]
+
+
+def test_span_orphan_detection(tmp_path):
+    """A span naming a never-closed parent must surface as an orphan, not
+    silently reattach — that is the self-check obs_report renders."""
+    from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
+    base = {"schema": SCHEMA_VERSION, "run_id": "r", "seq": 1, "t": 0.0,
+            "type": "span", "trace_id": "x", "start_ns": 0, "dur_ns": 1}
+    events = [{**base, "name": "root", "span_id": "s1"},
+              {**base, "name": "lost", "span_id": "s9",
+               "parent_span_id": "s404"}]
+    t = trace_trees(events)["x"]
+    assert tree_check(t)["orphans"] == 1
+    assert t["orphans"][0]["name"] == "lost"
+
+
+def test_tracer_phases_adapter_and_opt_out():
+    """Tracer(phases=Spans()) is the absorption path: every completed span
+    feeds the accumulator (under its phase alias when given), umbrella
+    spans opt out with phase=False, and events=None still accumulates —
+    un-telemetered runs keep phase accounting through the one path."""
+    from ddl25spring_tpu.telemetry.trace import Spans, Tracer
+    acc = Spans()
+    tr = Tracer(None, phases=acc)
+    with tr.span("dispatch", trace="train", phase=False) as root:
+        with tr.span("compute", parent=root.ctx, phase="dispatch"):
+            pass
+        with tr.span("stage", parent=root.ctx, phase="data"):
+            pass
+    assert acc.count("dispatch") == 1 and acc.count("data") == 1
+    assert acc.count("compute") == 0          # filed under the alias
+    assert acc.total("dispatch") >= 0.0
+    # The umbrella span itself must NOT have double-counted anything.
+    assert set(acc.as_dict()) == {"dispatch", "data"}
+
+
+def test_span_schema_v4_validation_and_v3_backcompat():
+    """span/slo_violation are v4 types with real required fields; a v3
+    stream (old types at schema 3) stays strictly valid under this
+    reader — the bump is additive."""
+    base = {"run_id": "r", "seq": 1, "t": 0.0}
+    ok = {**base, "schema": SCHEMA_VERSION, "type": "span", "name": "a",
+          "trace_id": "t", "span_id": "s1", "start_ns": 0, "dur_ns": 1}
+    assert validate_event(ok) == []
+    for missing in ("name", "trace_id", "span_id", "start_ns", "dur_ns"):
+        bad = {k: v for k, v in ok.items() if k != missing}
+        assert validate_event(bad) != [], missing
+    assert validate_event({**base, "schema": SCHEMA_VERSION,
+                           "type": "slo_violation", "slo": "ttft"}) == []
+    assert validate_event({**base, "schema": SCHEMA_VERSION,
+                           "type": "slo_violation"}) != []
+    # v3 (and v1) streams: every pre-v4 type validates unchanged.
+    for schema, ev in ((3, {"type": "fl_cohort", "round": 0, "tier": "edge",
+                            "cohort": 1}),
+                       (3, {"type": "fl_tier", "round": 0, "tier": "edge"}),
+                       (1, {"type": "step", "it": 0}),
+                       (2, {"type": "request_done", "req": "a",
+                            "tokens": 2})):
+        assert validate_event({**base, "schema": schema, **ev}) == []
+
+
+def test_trace_export_golden():
+    """Tiny stream -> EXACT Chrome trace JSON: metadata rows for the
+    process (run) and thread (trace), one complete event per span at
+    tracer-clock microseconds, and the flat fault event anchored as an
+    instant marker via the first span's epoch-vs-ns offset."""
+    from experiments.trace_export import chrome_trace
+    events = [
+        {"schema": 4, "run_id": "r", "seq": 1, "t": 100.0, "type": "span",
+         "name": "queue", "trace_id": "req-0", "span_id": "s2",
+         "parent_span_id": "s1", "start_ns": 1000, "dur_ns": 2000},
+        {"schema": 4, "run_id": "r", "seq": 2, "t": 100.5, "type": "span",
+         "name": "request", "trace_id": "req-0", "span_id": "s1",
+         "start_ns": 1000, "dur_ns": 6000, "tokens": 3},
+        {"schema": 4, "run_id": "r", "seq": 3, "t": 101.0, "type": "fault",
+         "counters": {"skipped_steps": 2}, "it": 7},
+    ]
+    # Instants anchor via the NEAREST span in epoch time — here the
+    # "request" span at t=100.5, whose end (start+dur ns) calibrates the
+    # epoch->span-clock offset.
+    anchor = 100.5 - (1000 + 6000) / 1e9
+    assert chrome_trace(events) == {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "run r"}},
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "req-0"}},
+            {"ph": "i", "name": "fault", "cat": "event", "s": "p",
+             "ts": (101.0 - anchor) * 1e6, "pid": 1, "tid": 0,
+             "args": {"counters": {"skipped_steps": 2}, "it": 7}},
+            {"ph": "X", "name": "queue", "cat": "span", "ts": 1.0,
+             "dur": 2.0, "pid": 1, "tid": 1,
+             "args": {"span_id": "s2", "parent_span_id": "s1"}},
+            {"ph": "X", "name": "request", "cat": "span", "ts": 1.0,
+             "dur": 6.0, "pid": 1, "tid": 1,
+             "args": {"tokens": 3, "span_id": "s1"}},
+        ],
+        "displayTimeUnit": "ms",
+    }
+    # --no-instants drops the marker but not the spans.
+    spans_only = chrome_trace(events, instants=False)
+    assert [e["ph"] for e in spans_only["traceEvents"]] == ["M", "M",
+                                                            "X", "X"]
+
+
+# ------------------------------------------------- slo monitor
+
+def _mk(seq, t, type, **fields):
+    return {"schema": SCHEMA_VERSION, "run_id": "r", "seq": seq, "t": t,
+            "type": type, **fields}
+
+
+def test_slo_monitor_flags_stalled_stream():
+    """The acceptance bar: a stream that goes silent with work
+    outstanding is flagged within ONE rolling window — the final
+    evaluation runs at the heartbeat's last beat, a window past the last
+    token, where the sustained-rate floor breaks."""
+    from experiments.slo_monitor import SLOConfig, check_stream
+    events = [_mk(1, 0.0, "request_enqueue", req="a"),
+              _mk(2, 0.2, "request_enqueue", req="b"),
+              _mk(3, 0.5, "request_token", req="a", i=0),
+              _mk(4, 1.0, "request_token", req="a", i=1),
+              _mk(5, 1.5, "request_token", req="a", i=2)]
+    cfg = SLOConfig(window_s=10.0, min_tokens_per_sec=0.1)
+    # Healthy read: the stream's own horizon still has tokens in window.
+    assert check_stream(events, cfg) == []
+    # Stall: the writer's heartbeat kept beating for one more window with
+    # zero tokens and both requests still outstanding.
+    violations = check_stream(events, cfg, heartbeat={"time": 12.0})
+    assert [v["slo"] for v in violations] == ["tokens_per_sec"]
+    assert violations[0]["value"] == 0.0
+    # Same silence with NOTHING outstanding is idleness, not a stall.
+    done = events + [_mk(6, 1.6, "request_done", req="a", tokens=3),
+                     _mk(7, 1.7, "request_done", req="b", tokens=0)]
+    assert check_stream(done, cfg, heartbeat={"time": 12.0}) == []
+
+
+def test_slo_monitor_ttft_and_transitions():
+    """p99 TTFT over the window; one incident per ok->breached transition
+    (a sustained breach must not spam one event per poll)."""
+    from experiments.slo_monitor import SLOConfig, SLOMonitor
+    cfg = SLOConfig(window_s=10.0, ttft_p99_s=1.0)
+    m = SLOMonitor(cfg)
+    m.feed([_mk(1, 0.0, "request_enqueue", req="a"),
+            _mk(2, 5.0, "request_done", req="a", tokens=2, ttft_s=4.0)])
+    assert [v["slo"] for v in m.evaluate(5.0)] == ["ttft_p99_s"]
+    assert m.evaluate(6.0) == []            # still breached: no re-fire
+    assert m.evaluate(20.0) == []           # window drained: recovered
+    assert not m.active
+    m.feed([_mk(3, 21.0, "request_done", req="b", tokens=1, ttft_s=9.0)])
+    assert [v["slo"] for v in m.evaluate(21.0)] == ["ttft_p99_s"]
+    assert len(m.violations) == 2
+
+
+def test_slo_monitor_guard_skip_rate():
+    from experiments.slo_monitor import SLOConfig, SLOMonitor
+    cfg = SLOConfig(window_s=100.0, max_skip_rate=0.2)
+    m = SLOMonitor(cfg)
+    m.feed([_mk(1, 1.0, "step", it=9, steps=10),
+            _mk(2, 2.0, "fault", counters={"skipped_steps": 5})])
+    viols = m.evaluate(3.0)
+    assert [v["slo"] for v in viols] == ["guard_skip_rate"]
+    # Skipped steps still consume their batches, so they are IN the step
+    # events' counts: rate = skips / steps.
+    assert viols[0]["value"] == pytest.approx(5 / 10)
+
+
+def test_slo_monitor_emits_events(tmp_path):
+    """Violations land in the stream as schema-v4 slo_violation events a
+    strict reader accepts — and obs_report renders them."""
+    from experiments.slo_monitor import SLOConfig, SLOMonitor
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="slo") as log:
+        m = SLOMonitor(SLOConfig(window_s=5.0, queue_p99_s=0.1), emit=log)
+        m.feed([_mk(1, 0.0, "request_done", req="a", tokens=1,
+                    queue_wait_s=3.0)])
+        m.evaluate(0.5)
+    events = read_events(path, strict=True)
+    assert [e["type"] for e in events] == ["slo_violation"]
+    assert events[0]["slo"] == "queue_p99_s"
+    from experiments.obs_report import main as report_main
+    assert report_main([path]) == 0
+
+
+def test_stream_tailer_incremental_and_torn_lines(tmp_path):
+    """The live tailer: picks up appends incrementally, buffers a torn
+    final line until its newline lands (never misparses a mid-write
+    line), and survives a shrink (healed fragment) by re-reading."""
+    from experiments.slo_monitor import StreamTailer
+    path = str(tmp_path / "events.jsonl")
+    t = StreamTailer(path)
+    assert t.poll() == []                       # no file yet: no signal
+    with open(path, "wb") as f:
+        f.write(b'{"type": "step", "it": 0}\n{"type": "st')
+        f.flush()
+        assert [e["it"] for e in t.poll()] == [0]   # torn tail buffered
+        f.write(b'ep", "it": 1}\n')
+        f.flush()
+        assert [e["it"] for e in t.poll()] == [1]   # seam healed exactly
+    os.truncate(path, 0)                        # recycled stream
+    with open(path, "ab") as f:
+        f.write(b'{"type": "step", "it": 7}\n')
+    assert [e["it"] for e in t.poll()] == [7]       # reset + re-read
+
+
+def test_two_tracers_one_trace_no_span_id_collision(tmp_path):
+    """The elastic wiring: the training loop's tracer and the controller's
+    tracer BOTH emit on trace 'train'. Independent per-tracer counters
+    must not collide (trace_trees keys spans by id — a collision silently
+    overwrites spans and corrupts the reassembled tree)."""
+    from ddl25spring_tpu.telemetry.trace import Tracer, trace_trees
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r") as log:
+        loop_tr, ctrl_tr = Tracer(log), Tracer(log)
+        with loop_tr.span("dispatch", trace="train", it=0):
+            pass
+        with ctrl_tr.span("remesh", trace="train", it=0) as rroot:
+            with ctrl_tr.span("restore", parent=rroot.ctx):
+                pass
+        with loop_tr.span("dispatch", trace="train", it=2):
+            pass
+    events = read_events(path, strict=True)
+    t = trace_trees(events)["train"]
+    assert len(t["spans"]) == len(events) == 4     # nothing overwritten
+    assert len(t["roots"]) == 3 and not t["orphans"]
+    ids = [e["span_id"] for e in events]
+    assert len(set(ids)) == 4
+
+
+def test_stream_tailer_from_end_skips_existing(tmp_path):
+    """from_end=True (the watchdog's relaunch attach): pre-existing events
+    — a dead run's outstanding request_enqueues — are never re-fed."""
+    from experiments.slo_monitor import StreamTailer
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "wb") as f:
+        f.write(b'{"type": "request_enqueue", "req": "dead"}\n')
+    t = StreamTailer(path, from_end=True)
+    assert t.poll() == []
+    with open(path, "ab") as f:
+        f.write(b'{"type": "request_enqueue", "req": "alive"}\n')
+    assert [e["req"] for e in t.poll()] == ["alive"]
+
+
+def test_slo_monitor_partial_first_window_rate():
+    """A healthy just-started stream must not read as a stall: during the
+    first partial window the rate divisor is the observed span, not the
+    full window (compile pushing the first token late would otherwise
+    deflate a true 12 tok/s below a 10 tok/s floor)."""
+    from experiments.slo_monitor import SLOConfig, SLOMonitor
+    m = SLOMonitor(SLOConfig(window_s=30.0, min_tokens_per_sec=10.0))
+    m.feed([_mk(1, 20.0, "request_enqueue", req="a")]
+           + [_mk(2 + i, 20.0 + i * 0.08, "request_token", req="a", i=i)
+              for i in range(120)])          # 12 tok/s from the start
+    # Evaluated at t=30 the stream has existed for 10s: dividing its 120
+    # tokens by the full 30s window would read 4 < 10 and cry stall at a
+    # healthy server — the observed span is what the floor judges.
+    assert m.evaluate(30.0) == []
+
+
+def test_sidecar_eventlog_never_truncates_live_stream(tmp_path):
+    """heal=False (the slo_monitor sidecar): attaching to a stream whose
+    final line is mid-write must NOT truncate it — the live writer's
+    O_APPEND continuation still lands after the fragment, and the
+    sidecar's first emit seals it with a leading newline instead."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="live") as live:
+        live.step(it=0, loss=1.0)
+    size_before = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b'{"schema": 4, "run_id": "live", "seq": 2, "t": 0, "ty')
+    frag_size = os.path.getsize(path)
+    sidecar = EventLog(path, run_id="slo", heal=False)
+    assert os.path.getsize(path) == frag_size     # nothing truncated
+    sidecar.slo_violation(slo="ttft_p99_s", value=2.0, threshold=1.0)
+    sidecar.close()
+    # The fragment stays one skippable malformed line; both real events
+    # survive; the default (heal=True) path in the same state would have
+    # truncated back to size_before.
+    events = read_events(path)
+    assert [(e["run_id"], e["type"]) for e in events] == [
+        ("live", "step"), ("slo", "slo_violation")]
+    assert size_before < frag_size
+
+
+def test_trace_trees_partitions_by_run_id():
+    """Relaunches share a file, a trace name AND a span-id sequence (each
+    process's first tracer is instance 1): trace_trees must keep the runs'
+    trees apart instead of silently overwriting spans."""
+    from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
+    def span(run, sid, name, parent=None, start=0):
+        e = {"schema": SCHEMA_VERSION, "run_id": run, "seq": 1, "t": 0.0,
+             "type": "span", "trace_id": "train", "name": name,
+             "span_id": sid, "start_ns": start, "dur_ns": 1}
+        if parent:
+            e["parent_span_id"] = parent
+        return e
+    events = [span("run1", "s1.2", "compute", "s1.1"),
+              span("run1", "s1.1", "dispatch"),
+              span("run2", "s1.2", "compute", "s1.1", start=5),
+              span("run2", "s1.1", "dispatch", start=5)]
+    trees = trace_trees(events)
+    assert set(trees) == {"train", "run2/train"}
+    for t in trees.values():
+        assert tree_check(t) == {"roots": 1, "orphans": 0, "imbalanced": 0}
+        assert len(t["spans"]) == 2
+
+
+def test_slo_monitor_counts_done_tokens_without_token_events():
+    """Scheduler(token_events=False) streams carry throughput only at
+    completion granularity; the tok/s floor must read it there instead of
+    declaring every such server stalled."""
+    from experiments.slo_monitor import SLOConfig, SLOMonitor
+    cfg = SLOConfig(window_s=10.0, min_tokens_per_sec=1.0)
+    m = SLOMonitor(cfg)
+    m.feed([_mk(1, 0.0, "request_enqueue", req="a"),
+            _mk(2, 1.0, "request_enqueue", req="b"),
+            _mk(3, 5.0, "request_done", req="a", tokens=40)])
+    assert m.evaluate(8.0) == []            # 40 tokens/8s, healthy
+    # A stream WITH token events never double-counts the done totals.
+    m2 = SLOMonitor(cfg)
+    m2.feed([_mk(1, 0.0, "request_enqueue", req="a"),
+             _mk(2, 0.5, "request_enqueue", req="b")]
+            + [_mk(3 + i, 1.0 + i, "request_token", req="a", i=i)
+               for i in range(4)]
+            + [_mk(9, 5.0, "request_done", req="a", tokens=4)])
+    assert sum(n for _, n in m2._tokens) == 4
+
+
+def test_slo_monitor_cold_start_grace_then_stall():
+    """No token has EVER arrived: that is startup (XLA compile), not a
+    throughput deficit — the floor stays quiet for one full window from
+    the stream's birth, then a still-token-less stream IS a stall."""
+    from experiments.slo_monitor import SLOConfig, SLOMonitor
+    m = SLOMonitor(SLOConfig(window_s=30.0, min_tokens_per_sec=0.5))
+    m.feed([_mk(1, 0.0, "request_enqueue", req="a")])
+    assert m.evaluate(10.0) == []           # compiling, within grace
+    assert m.evaluate(29.0) == []
+    viols = m.evaluate(31.0)                # a window with zero tokens
+    assert [v["slo"] for v in viols] == ["tokens_per_sec"]
+    assert viols[0]["value"] == 0.0
+
+
+def test_stream_tailer_from_end_survives_heal_shrink(tmp_path):
+    """A relaunched writer's EventLog heals a torn fragment by TRUNCATING
+    a few bytes; a from_end tailer must re-attach at the new end, not
+    reset to 0 and replay the dead run's history (whose never-completed
+    enqueues would poison the fresh monitor's outstanding counters)."""
+    from experiments.slo_monitor import StreamTailer
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "wb") as f:
+        f.write(b'{"type": "request_enqueue", "req": "dead"}\n')
+        f.write(b'{"type": "st')                    # torn fragment
+    t = StreamTailer(path, from_end=True)
+    assert t.poll() == []
+    with open(path, "r+b") as f:                    # the relaunch heals...
+        f.truncate(len(b'{"type": "request_enqueue", "req": "dead"}\n'))
+    with open(path, "ab") as f:                     # ...and writes anew
+        f.write(b'{"type": "request_enqueue", "req": "alive"}\n')
+    assert [e["req"] for e in t.poll()] == ["alive"]
