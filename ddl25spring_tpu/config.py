@@ -160,6 +160,14 @@ class TrainConfig:
     # telemetry step events, checkpoint saves, StepGuard verdicts, preempt
     # checks) quantizes to chunk edges — see train/llm.py:_run_loop.
     steps_per_dispatch: int = 1
+    # In-jit numerics summaries (telemetry/introspect.py; DP trainer,
+    # gradient/zero1): N > 0 instruments the compiled step with
+    # per-layer-group grad/param/update norms + per-leaf NaN attribution
+    # and emits a ``numerics`` event every N steps (the emission syncs the
+    # tiny summary arrays; the in-jit compute itself is free and
+    # bitwise-invisible — losses/params identical on vs off, pinned in
+    # tests/test_introspect.py). 0 disables instrumentation entirely.
+    numerics_every: int = 0
 
 
 @dataclass(frozen=True)

@@ -76,6 +76,7 @@ import numpy as np
 
 from .. import rng as rngmod
 from ..config import FLConfig
+from ..telemetry import introspect
 from ..telemetry.comm import tree_bytes
 from ..telemetry.trace import Tracer
 from ..utils import pytree as pt
@@ -369,9 +370,23 @@ class FleetFedAvgServer(_ServerBase):
             ups = jax.vmap(client)(xs, ys, ms, keys, gids, active)
             return jax.tree.map(lambda u: u.sum(0), ups)
 
-        self._stream_step = stream_step
-        self._collect_step = collect_step
-        self._secagg_step = secagg_step
+        # Compile/retrace observability (telemetry/introspect.py): each
+        # cohort step's documented invariant is ONE compiled program —
+        # ragged cohorts pad, raggedness is data, dropout pads survivors.
+        # The watch emits ``compile`` events into the fleet's stream and
+        # flags any growth past one cache entry as a retrace
+        # (``_cache_size()==1`` stays pinned in tests through the watch's
+        # attribute delegation).
+        _events = telemetry.events if telemetry is not None else None
+        self._stream_step = introspect.watch(
+            stream_step, name="fleet/stream_step", max_caches=1,
+            events=_events)
+        self._collect_step = introspect.watch(
+            collect_step, name="fleet/collect_step", max_caches=1,
+            events=_events)
+        self._secagg_step = introspect.watch(
+            secagg_step, name="fleet/secagg_step", max_caches=1,
+            events=_events)
 
     # ------------------------------------------------------------- plumbing
     def _edge_width(self, e: int) -> int:

@@ -80,8 +80,8 @@ def sharded_opt_init(mesh: Mesh, params, optimizer: optax.GradientTransformation
 
 
 def _make_local_grad_step(loss_fn: Callable, optimizer, accum_steps: int,
-                          guard_nonfinite: bool, comm_scale: int = 1
-                          ) -> Callable:
+                          guard_nonfinite: bool, comm_scale: int = 1,
+                          numerics=None) -> Callable:
     """The per-shard gradient-aggregation step body shared by the per-step
     factory (``make_grad_aggregation_step``) and the K-step scan driver
     (``make_multi_step``) — one implementation, so the two cannot drift.
@@ -89,7 +89,19 @@ def _make_local_grad_step(loss_fn: Callable, optimizer, accum_steps: int,
     ``comm_scale`` is the telemetry execution multiplier: inside a
     ``lax.scan`` body the collectives trace once but run ``K`` times per
     dispatch, and the comm wrappers record that trip count so the static
-    wire-byte profile stays exact (telemetry/comm.py ``scale``)."""
+    wire-byte profile stays exact (telemetry/comm.py ``scale``).
+
+    ``numerics`` (telemetry.introspect.NumericsHandle) turns on the
+    in-jit run-health summary: the step's second output becomes
+    ``(loss, NumericsSummary)`` — per-layer-group grad/param/update norms
+    plus the per-leaf gradient finite mask, computed from values the step
+    already holds. Extra OUTPUTS never perturb the existing computation,
+    so losses/params are bitwise identical with the summary on or off
+    (pinned in tests/test_introspect.py). On THIS (replicated-gradient)
+    path the summary reflects the ATTEMPTED update — under
+    ``guard_nonfinite`` a skipped step still reports the norms/finite-mask
+    of the update it refused (the zero1 body differs; see
+    ``_make_zero1_local_step``)."""
 
     def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
         if accum_steps == 1:
@@ -124,6 +136,8 @@ def _make_local_grad_step(loss_fn: Callable, optimizer, accum_steps: int,
                           scale=comm_scale)
         params, opt_state = apply_optimizer(optimizer, grads,
                                             state.opt_state, state.params)
+        summary = (numerics.summarize(state.params, grads, params)
+                   if numerics is not None else None)
         if guard_nonfinite:
             ok = jnp.isfinite(loss)
             for g in jax.tree.leaves(grads):
@@ -135,16 +149,19 @@ def _make_local_grad_step(loss_fn: Callable, optimizer, accum_steps: int,
                                   params, state.params)
             opt_state = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
                                      opt_state, state.opt_state)
-            return TrainState(params, opt_state,
-                              state.step + ok.astype(state.step.dtype)), loss
-        return TrainState(params, opt_state, state.step + 1), loss
+            new_state = TrainState(params, opt_state,
+                                   state.step + ok.astype(state.step.dtype))
+        else:
+            new_state = TrainState(params, opt_state, state.step + 1)
+        return new_state, ((loss, summary) if summary is not None else loss)
 
     return local_step
 
 
 def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                                mesh: Mesh, accum_steps: int = 1,
-                               guard_nonfinite: bool = False) -> Callable:
+                               guard_nonfinite: bool = False,
+                               numerics=None) -> Callable:
     """jit-compiled SPMD step: local grads -> pmean over ``data`` -> update.
 
     ``loss_fn(params, batch) -> scalar``. The batch's leading axis is sharded
@@ -170,9 +187,13 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
     host as the returned non-finite loss and the non-advancing ``step``.
     The host-side StepGuard (resilience/guard.py) layers EMA anomaly
     detection and checkpoint rollback on top when those are wanted.
+
+    ``numerics`` (see ``_make_local_grad_step``) changes the second
+    output to ``(loss, NumericsSummary)`` — replicated, computed from the
+    post-pmean gradient, bitwise-free for losses/params.
     """
     local_step = _make_local_grad_step(loss_fn, optimizer, accum_steps,
-                                       guard_nonfinite)
+                                       guard_nonfinite, numerics=numerics)
     sharded = shard_map(
         local_step,
         mesh=mesh,
@@ -185,7 +206,7 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
 
 def make_multi_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Mesh, accum_steps: int = 1,
-                    guard_nonfinite: bool = False) -> Callable:
+                    guard_nonfinite: bool = False, numerics=None) -> Callable:
     """Fused K-step driver: ``step(state, window) -> (state, losses)`` where
     ``window`` is a device-resident ``[K, n_shards·B, T]`` batch window
     (leading axis = consecutive training steps, second axis sharded over
@@ -209,7 +230,8 @@ def make_multi_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     def multi(state: TrainState, window):
         local_step = _make_local_grad_step(loss_fn, optimizer, accum_steps,
                                            guard_nonfinite,
-                                           comm_scale=window.shape[0])
+                                           comm_scale=window.shape[0],
+                                           numerics=numerics)
         return lax.scan(local_step, state, window)
 
     sharded = shard_map(
@@ -293,7 +315,7 @@ def _zero1_setup(optimizer, mesh: Mesh, params):
 def _make_zero1_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                            local: int, total: int, *,
                            guard_nonfinite: bool = False,
-                           comm_scale: int = 1) -> Callable:
+                           comm_scale: int = 1, numerics=None) -> Callable:
     """The per-shard ZeRO-1 step body shared by ``make_zero1_step`` and
     ``make_zero1_multi_step``: local grads → reduce-scatter (each shard
     receives the averaged 1/n-th of the flat gradient) → optimizer update on
@@ -341,6 +363,19 @@ def _make_zero1_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
         # unravel is dtype-polymorphic and would silently rebuild non-fp32
         # params (e.g. param_dtype="bfloat16") as fp32.
         new_params = unravel(flat_new.astype(raw_flat.dtype))
+        if numerics is not None:
+            # Built with psum_axis="data": the LOCAL grads differ per
+            # shard, so the summarizer psum-agrees the grad stats + finite
+            # mask inside this same dispatch (introspect.make_summarizer).
+            # Under ``guard_nonfinite`` the summary here describes the
+            # POST-guard state (a skipped step reports update ≈ 0) — the
+            # attempted update's magnitude would cost a second all-gather
+            # of the unselected slices; the grad norms and finite mask
+            # still describe the FAULTED gradient, which is the
+            # attribution a postmortem needs. The replicated-gradient
+            # path reports the attempted update (no extra wire there).
+            summary = numerics.summarize(params, grads, new_params)
+            return TrainState(new_params, opt_state, step), (loss, summary)
         return TrainState(new_params, opt_state, step), loss
 
     return local_step
@@ -348,7 +383,8 @@ def _make_zero1_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
 
 def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Mesh, params, *,
-                    guard_nonfinite: bool = False) -> Tuple[TrainState, Callable]:
+                    guard_nonfinite: bool = False,
+                    numerics=None) -> Tuple[TrainState, Callable]:
     """ZeRO-1 data parallelism: optimizer state sharded across the ``data``
     axis (parity-plus — SURVEY.md §2.10 marks ZeRO/FSDP absent in the
     reference; pattern reference: "Automatic Cross-Replica Sharding of
@@ -386,7 +422,9 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     state, opt_specs, n, pad, local, total = _zero1_setup(optimizer, mesh,
                                                           params)
     local_step = _make_zero1_local_step(loss_fn, optimizer, n, pad, local,
-                                        total, guard_nonfinite=guard_nonfinite)
+                                        total,
+                                        guard_nonfinite=guard_nonfinite,
+                                        numerics=numerics)
     step = shard_map(
         local_step, mesh=mesh,
         in_specs=(TrainState(P(), opt_specs, P()), P("data")),
@@ -398,7 +436,7 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
 def make_zero1_multi_step(loss_fn: Callable,
                           optimizer: optax.GradientTransformation,
                           mesh: Mesh, params, *,
-                          guard_nonfinite: bool = False
+                          guard_nonfinite: bool = False, numerics=None
                           ) -> Tuple[TrainState, Callable]:
     """The two hot-path levers composed: the ZeRO-1 sharded weight update
     *inside* the K-step scan driver. ``step(state, window) -> (state,
@@ -414,7 +452,8 @@ def make_zero1_multi_step(loss_fn: Callable,
     def multi(state: TrainState, window):
         local_step = _make_zero1_local_step(
             loss_fn, optimizer, n, pad, local, total,
-            guard_nonfinite=guard_nonfinite, comm_scale=window.shape[0])
+            guard_nonfinite=guard_nonfinite, comm_scale=window.shape[0],
+            numerics=numerics)
         return lax.scan(local_step, state, window)
 
     step = shard_map(

@@ -20,7 +20,11 @@ It can, at chosen steps/rounds:
 Plans parse from a compact spec string so bench.py / experiments can take
 them straight off a CLI flag or config field::
 
-    "nan_grad@10"                 NaN gradient at step 10
+    "nan_grad@10"                 NaN gradient at step 10 (all leaves)
+    "nan_grad@10:3"               NaN confined to leaf #3 (1-based index in
+                                  tree-flatten-with-path order — the order
+                                  telemetry.introspect.leaf_paths reports;
+                                  what the NaN-attribution tests inject)
     "spike_grad@5:100"            gradient scaled by 100 at step 5
     "preempt@25"                  SIGTERM delivered before step 25
     "drop_client@3:2"             2 clients vanish in round 3
@@ -190,9 +194,19 @@ class FaultPlan:
         ``spike_grad`` re-applies the step's parameter delta scaled by
         ``arg`` (default 100x) — the update a ``arg``-times-larger gradient
         step would have produced under SGD-like geometry, which is what an
-        EMA update-norm detector must catch. Preemption sends SIGTERM to
-        this process BEFORE the step runs, modeling the scheduler's kill
+        EMA update-norm detector must catch. ``nan_grad``/``inf_grad``
+        with a nonzero ``arg`` confine the poison to leaf #``arg``
+        (1-based, tree-flatten-with-path order) — the targeted fault the
+        NaN-leaf-attribution machinery (StepGuard.pop_trip, the flight
+        recorder) is tested against. Preemption sends SIGTERM to this
+        process BEFORE the step runs, modeling the scheduler's kill
         landing at a step boundary.
+
+        Steps instrumented with in-jit numerics (telemetry/introspect.py)
+        return ``(loss, summary)``; the poison lands on the loss and the
+        summary rides through untouched (it describes the step the fault
+        was injected AFTER — the guard's host-side attribution covers the
+        poisoned state itself).
         """
         import jax
         import jax.numpy as jnp
@@ -217,9 +231,10 @@ class FaultPlan:
                 # input state, so the pre-step params are gone afterwards.
                 # Fault-free steps pay nothing.
                 old_params = _tree_copy(state.params)
-            new_state, loss = step_fn(state, batch)
+            new_state, out = step_fn(state, batch)
             if e is None:
-                return new_state, loss
+                return new_state, out
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
             if e.kind == "spike_grad":
                 scale = e.arg if e.arg else 100.0
                 params = jax.tree.map(
@@ -228,10 +243,15 @@ class FaultPlan:
                 loss = loss * scale
             else:
                 bad = jnp.nan if e.kind == "nan_grad" else jnp.inf
-                params = jax.tree.map(lambda p: jnp.full_like(p, bad),
-                                      new_state.params)
+                target = int(e.arg) if e.arg else 0     # 0 = every leaf
+                leaves, treedef = jax.tree.flatten(new_state.params)
+                params = treedef.unflatten([
+                    jnp.full_like(p, bad)
+                    if target in (0, i + 1) else p
+                    for i, p in enumerate(leaves)])
                 loss = jnp.full_like(loss, bad)
-            return new_state._replace(params=params), loss
+            out = (loss, aux) if aux is not None else loss
+            return new_state._replace(params=params), out
 
         return wrapped
 

@@ -111,10 +111,25 @@ class StepGuard:
         self._ema: Optional[float] = None
         self._good_steps = 0
         self._consecutive_bad = 0
+        self._last_trip: Optional[dict] = None
+
+    def pop_trip(self) -> Optional[dict]:
+        """Attribution of the most recent bad step, then clears it: which
+        leaf paths of the REJECTED state carried NaN/Inf, whether the loss
+        was non-finite, the update norm vs the EMA. The training loop
+        attaches this to the ``fault`` event it emits, which is what lets
+        a flight-recorder bundle NAME the faulted leaf instead of
+        reporting "nonfinite somewhere"."""
+        trip, self._last_trip = self._last_trip, None
+        return trip
 
     def __call__(self, state, batch) -> Tuple[Any, jnp.ndarray]:
         old = _tree_copy(state)          # survives the step's donation
-        new_state, loss = self._step_fn(state, batch)
+        new_state, out = self._step_fn(state, batch)
+        # Instrumented steps (telemetry/introspect.py) return
+        # (loss, NumericsSummary); the guard verdicts on the loss and
+        # passes the pair through untouched either way.
+        loss = out[0] if isinstance(out, tuple) else out
         finite, upd_norm = _verdict(old.params, new_state.params, loss)
         ok = bool(finite)
         anomalous = False
@@ -128,24 +143,39 @@ class StepGuard:
                          + (1.0 - self.ema_decay) * u)
             self._good_steps += 1
             self._consecutive_bad = 0
-            return new_state, loss
+            return new_state, out
         # Bad step: count, skip (numerically a no-op), maybe roll back.
         # A chunked dispatch (vector loss) skips loss.size train steps.
         if anomalous:
             self.stats.anomalies += 1
         else:
             self.stats.skipped_steps += int(getattr(loss, "size", 1) or 1)
+        # Attribution on the fault path only (it syncs the rejected
+        # params): name WHICH leaves went non-finite before the poisoned
+        # state is dropped — after the skip the only copy is gone.
+        try:
+            from ..telemetry.introspect import nonfinite_leaves
+            import numpy as np
+            self._last_trip = {
+                "anomalous": anomalous,
+                "loss_nonfinite": not bool(
+                    np.isfinite(np.asarray(loss)).all()),
+                "update_norm": float(upd_norm),
+                "nonfinite_params": nonfinite_leaves(new_state.params),
+            }
+        except Exception:
+            self._last_trip = None
         self._consecutive_bad += 1
         if (self._ckpt is not None
                 and self._consecutive_bad >= self.max_consecutive_bad):
             try:
                 restored = self._ckpt.restore(old)
             except FileNotFoundError:
-                return old, loss          # nothing on disk yet; keep skipping
+                return old, out           # nothing on disk yet; keep skipping
             self.stats.rollbacks += 1
             self._consecutive_bad = 0
-            return restored, loss
-        return old, loss
+            return restored, out
+        return old, out
 
 
 def measure_overhead(make_state_and_step, batch, *, steps: int = 20,

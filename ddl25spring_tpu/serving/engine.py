@@ -310,9 +310,19 @@ class Engine:
         self.last_tok = np.zeros(num_slots, np.int32)
         self.temps = np.zeros(num_slots, np.float32)
         self.keys = jnp.zeros((num_slots, 2), jnp.uint32)
-        self._prefill = make_prefill_chunk(cfg, paged, prefill_chunk,
-                                           top_k, top_p)
-        self._decode = make_decode_step(cfg, paged, num_slots, top_k, top_p)
+        # Compile/retrace observability (telemetry/introspect.py): the
+        # engine's contract is EXACTLY two compiled programs — admission,
+        # retirement and raggedness are data, never shapes. The watches
+        # enforce that as a budget (growth past one cache entry each is a
+        # flagged retrace) and emit ``compile`` events once the scheduler
+        # binds its event stream (introspect.bind_events).
+        from ..telemetry import introspect
+        self._prefill = introspect.watch(
+            make_prefill_chunk(cfg, paged, prefill_chunk, top_k, top_p),
+            name="serving/prefill_chunk", max_caches=1)
+        self._decode = introspect.watch(
+            make_decode_step(cfg, paged, num_slots, top_k, top_p),
+            name="serving/decode_step", max_caches=1)
 
     # ------------------------------------------------------------- admission
     def required_blocks(self, prompt_len: int, max_new: int) -> int:

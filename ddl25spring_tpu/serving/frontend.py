@@ -137,6 +137,11 @@ class ServingReport:
     naive_bytes_at_peak: int = 0
     peak_concurrency: int = 0
     requests: List[Request] = field(default_factory=list)
+    # Compile/retrace accounting (telemetry/introspect.py CompileWatch on
+    # the engine's two programs): the engine's contract is compiles == 2
+    # and retraces == 0 for ANY workload — raggedness is data, not shapes.
+    compiles: int = 0
+    retraces: int = 0
 
 
 def aggregate_latency(records: Dict[str, RequestRecord],
@@ -210,6 +215,9 @@ def run_serving(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
         wall_s=clock.now(),
         peak_blocks_in_use=engine.allocator.peak_in_use,
         pool_blocks=engine.allocator.capacity,
+        compiles=(len(engine._prefill.compiles)
+                  + len(engine._decode.compiles)),
+        retraces=engine._prefill.retraces + engine._decode.retraces,
         pool_bytes=pool_bytes(cfg, paged),
         naive_bytes_at_peak=naive_cache_bytes(
             cfg, max(1, peak_conc), paged.max_seq_len, paged.kv_dtype),

@@ -136,6 +136,14 @@ class Scheduler:
         self.events = events
         self.token_events = token_events
         self.clock = clock
+        if events is not None:
+            # Late-bind the stream to the engine's compile watches: the
+            # engine is built before any telemetry exists, but its two
+            # compilations (and any retrace — a budget violation) should
+            # land in THIS scheduler's event stream.
+            from ..telemetry.introspect import bind_events
+            bind_events(engine._prefill, events)
+            bind_events(engine._decode, events)
         # Per-request trace trees ride the scheduler's OWN clock (the load
         # harness fast-forwards it through idle gaps), so span timestamps
         # and the queue_wait_s/ttft_s latency fields share one timebase.

@@ -33,6 +33,9 @@ from .costs import flops_crosscheck, hlo_cost
 from .events import (EventLog, SCHEMA_VERSION, default_run_id, read_events,
                      validate_event)
 from .heartbeat import Heartbeat, read_heartbeat
+from .introspect import (CompileWatch, FlightRecorder, NumericsSummary,
+                         bind_events, make_summarizer, platform_peaks,
+                         watch)
 from .registry import MetricsRegistry
 from .trace import (Span, SpanContext, Spans, Tracer, device_trace,
                     trace_trees, tree_check)
@@ -51,11 +54,12 @@ def __getattr__(name: str):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
-    "CommProfile", "EventLog", "Heartbeat", "MetricsRegistry",
-    "SCHEMA_VERSION", "Span", "SpanContext", "Spans", "Telemetry", "Tracer",
+    "CommProfile", "CompileWatch", "EventLog", "FlightRecorder",
+    "Heartbeat", "MetricsRegistry", "NumericsSummary", "SCHEMA_VERSION",
+    "Span", "SpanContext", "Spans", "Telemetry", "Tracer", "bind_events",
     "default_run_id", "device_trace", "flops_crosscheck", "hlo_cost",
-    "measure_comm", "read_events", "read_heartbeat", "trace_trees",
-    "tree_check", "validate_event",
+    "make_summarizer", "measure_comm", "platform_peaks", "read_events",
+    "read_heartbeat", "trace_trees", "tree_check", "validate_event", "watch",
 ]
 
 EVENTS_NAME = "events.jsonl"
@@ -73,10 +77,17 @@ class Telemetry:
     host sync of the loss (same cost model as the trainers' ``loss_sink``),
     so the default matches the trainers' ``sink_every``. The heartbeat is
     sync-free and beats every iteration regardless.
+
+    ``flight=True`` (default) arms the anomaly flight recorder
+    (introspect.FlightRecorder): a bounded ring over this run's events,
+    dumped as a self-contained postmortem bundle under
+    ``<out_dir>/postmortem/`` the moment a ``fault``/``remesh``/
+    ``slo_violation`` event crosses the stream. Zero cost until a trigger
+    fires; render bundles with ``python -m experiments.postmortem``.
     """
 
     def __init__(self, out_dir: str, *, run_id: Optional[str] = None,
-                 step_every: int = 10):
+                 step_every: int = 10, flight: bool = True):
         os.makedirs(out_dir, exist_ok=True)
         self.out_dir = out_dir
         self.run_id = run_id or default_run_id()
@@ -88,6 +99,10 @@ class Telemetry:
                                run_id=self.run_id)
         self.heartbeat = Heartbeat(os.path.join(out_dir, HEARTBEAT_NAME))
         self.registry = MetricsRegistry()
+        self.flight = None
+        if flight:
+            self.flight = FlightRecorder(os.path.join(out_dir, "postmortem"))
+            self.events.observers.append(self.flight.observe)
         # No default Tracer here: every emitter needs its own (the
         # serving scheduler binds its fast-forwarded clock, the trainers
         # their phase accumulator), and an unused one would burn a slot

@@ -44,10 +44,15 @@ from typing import Any, Dict, Iterator, List, Optional
 # ``span`` (one closed trace span: telemetry/trace.py's Tracer, exported
 # to Chrome trace JSON by experiments/trace_export.py) and
 # ``slo_violation`` (experiments/slo_monitor.py's rolling-window verdicts).
-# Version bumps are additive: a v4 reader accepts v1/v2/v3 streams
-# unchanged, and older readers reject v4 (the "future schema" rule in
-# validate_event) rather than misread it.
-SCHEMA_VERSION = 4
+# v5: run-health introspection (telemetry/introspect.py) — ``numerics``
+# (in-jit per-layer-group grad/param/update norms + per-leaf NaN
+# attribution, sampled from the training loop at a configurable cadence)
+# and ``compile`` (one XLA compilation of a watched jit entry point:
+# wall seconds, cache size, retrace flag, HLO flops/bytes for roofline
+# attainment). Version bumps are additive: a v5 reader accepts v1–v4
+# streams unchanged, and older readers reject v5 (the "future schema"
+# rule in validate_event) rather than misread it.
+SCHEMA_VERSION = 5
 
 # Event types this schema version defines. The type set is CLOSED per
 # schema version: ``validate_event`` checks base fields for all types, the
@@ -58,7 +63,7 @@ SCHEMA_VERSION = 4
 EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
                "request_done", "fl_cohort", "fl_tier", "span",
-               "slo_violation")
+               "slo_violation", "numerics", "compile")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -107,6 +112,21 @@ _REQUIRED: Dict[str, tuple] = {
     # (e.g. "ttft_p99_s"), ``value``/``threshold`` the measurement vs the
     # target, ``window_s`` the window it was measured over.
     "slo_violation": ("slo",),
+    # Run-health numerics (telemetry/introspect.py, schema v5): one
+    # in-jit sample per cadence boundary — ``it`` is the stream position,
+    # extras carry ``grad_norm`` (global), ``groups`` (per-layer-group
+    # grad/param norms + update/param ratio, worst-first), ``worst_group``
+    # / ``worst_update_ratio``, and ``nonfinite_grads`` (leaf paths) when
+    # a gradient went non-finite. Computed INSIDE the compiled step —
+    # bitwise-free instrumentation, no extra dispatch.
+    "numerics": ("it",),
+    # Compile/retrace accounting (introspect.CompileWatch, schema v5):
+    # one event per XLA compilation of a watched jit entry point —
+    # ``name`` the factory label, ``seconds`` the compiling call's wall
+    # time; extras carry ``cache_size``, ``retrace`` (True = the
+    # factory's documented compile budget was exceeded), and
+    # ``flops``/``bytes_accessed`` from costs.hlo_cost for attainment.
+    "compile": ("name", "seconds"),
 }
 
 
@@ -128,6 +148,12 @@ class EventLog:
         self.run_id = run_id or default_run_id()
         self._seq = 0
         self._lock = threading.Lock()
+        # In-process taps on the emitted stream (the flight recorder's
+        # feed — introspect.FlightRecorder.observe). Called AFTER the
+        # write, outside the lock (an observer must be able to do IO of
+        # its own without serializing emitters), each guarded: a broken
+        # observer loses its tap, never the event or the run.
+        self.observers: List[Any] = []
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -237,6 +263,11 @@ class EventLog:
                 self.write_errors += 1
                 if wrote:   # 0 bytes = file unchanged, keep prior state
                     self._torn_tail = wrote < len(data)
+        for obs in self.observers:
+            try:
+                obs(record)
+            except Exception:
+                pass       # an observer must never sink the emitter
         return record
 
     # Typed conveniences — thin, so the schema has one authoritative shape.
@@ -297,6 +328,14 @@ class EventLog:
     # Live SLO monitoring (schema v4; experiments/slo_monitor.py emits).
     def slo_violation(self, *, slo: str, **fields) -> Dict[str, Any]:
         return self.emit("slo_violation", slo=slo, **fields)
+
+    # Run-health introspection (schema v5; telemetry/introspect.py).
+    def numerics(self, *, it: int, **fields) -> Dict[str, Any]:
+        return self.emit("numerics", it=it, **fields)
+
+    def compile(self, *, name: str, seconds: float,
+                **fields) -> Dict[str, Any]:
+        return self.emit("compile", name=name, seconds=seconds, **fields)
 
     def close(self) -> None:
         with self._lock:

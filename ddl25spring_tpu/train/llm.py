@@ -26,6 +26,7 @@ from ..metrics import ResilienceStats
 from ..models import llama
 from ..parallel import dp, make_mesh, pp
 from ..resilience.preemption import PreemptionHandler
+from ..telemetry import introspect
 from ..telemetry.trace import Spans, Tracer
 from ..tokenizers import load_tokenizer
 
@@ -177,13 +178,29 @@ def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
                         if profile is not None else None)
     except Exception:
         pass                       # telemetry must never sink a trainer
+    platform = jax.devices()[0].platform
     telemetry.events.manifest(
         trainer=trainer, jax_version=jax.__version__,
-        platform=jax.devices()[0].platform, n_devices=len(jax.devices()),
+        platform=platform, n_devices=len(jax.devices()),
         mesh={k: int(v) for k, v in mesh.shape.items()},
         model_cfg=dataclasses.asdict(model_cfg),
         train_cfg=dataclasses.asdict(train_cfg),
-        start_step=start_step, comm=comm_profile)
+        start_step=start_step, comm=comm_profile,
+        # Roofline denominators (introspect.platform_peaks: ROOFLINE.md's
+        # measured chip peaks, or a calibrated CPU baseline) — recorded
+        # HERE so the jax-free readers (obs_report's attainment section,
+        # slo_monitor's MFU floor) never have to re-derive them.
+        peaks=introspect.platform_peaks(platform))
+
+
+def _fault_extra(step_fn) -> dict:
+    """StepGuard trip attribution (non-finite leaf paths of the rejected
+    state) as extra ``fault``-event fields — and from the stream into the
+    flight-recorder bundle that dumps on it. Shared by ``_run_loop`` and
+    ``_run_elastic_loop`` so the two cannot drift."""
+    pop = getattr(step_fn, "pop_trip", None)
+    trip = pop() if callable(pop) else None
+    return {"attribution": trip} if trip else {}
 
 
 def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
@@ -192,7 +209,8 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               warmup_steps_excluded: int,
               stats: Optional[ResilienceStats] = None,
               telemetry=None, steps_per_dispatch: int = 1,
-              window_shard_fn=None) -> LLMTrainReport:
+              window_shard_fn=None, numerics=None,
+              numerics_every: int = 0, compile_watch=None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
     and async-honest throughput accounting (the timer starts after
@@ -245,6 +263,23 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
       stays out of the timer either way);
     - the next chunk's host window is staged while the device runs the
       current one, so tokenization overlaps compute under async dispatch.
+
+    Run-health introspection (``numerics`` = a
+    telemetry.introspect.NumericsHandle, ``numerics_every`` > 0): the
+    step's second output is ``(loss, NumericsSummary)`` — computed inside
+    the same compiled dispatch, bitwise-invisible to losses/params — and
+    the loop emits a ``numerics`` event every ``numerics_every`` steps
+    (chunked mode samples the chunk's LAST step), plus one forced sample
+    alongside every ``fault`` event so a flight-recorder bundle always
+    carries the numerics state at the trip. Fault events additionally
+    carry the StepGuard's ``pop_trip()`` attribution — the non-finite
+    leaf PATHS of the rejected state.
+
+    ``compile_watch`` (the step's introspect.CompileWatch, passed
+    UNWRAPPED since the guard/fault layers don't delegate): a ``compute``
+    span whose dispatch compiled (warmup, a tail-chunk shape) is stamped
+    ``compiled=True`` so obs_report's attainment percentiles can exclude
+    it — a compile-dominated interval is not an attainment sample.
     """
     report = LLMTrainReport()
     report.start_step = start_step
@@ -269,6 +304,24 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     last_replay_beat = -math.inf  # first replayed batch always beats
     prev_counters = report.resilience.as_dict()
     last_saved = -1
+    # First eligible step emits immediately; subsequent samples follow the
+    # cadence. Tracked by stream position so chunked mode (which only sees
+    # chunk edges) samples the first edge at/after each boundary.
+    last_numerics_it = start_step - max(1, numerics_every)
+
+    def _emit_numerics(it, aux, index=None):
+        nonlocal last_numerics_it
+        if aux is None or telemetry is None or numerics is None \
+                or last_numerics_it == it:  # cadence + forced: one sample
+            return
+        try:
+            telemetry.events.numerics(it=it,
+                                      **numerics.event_fields(aux,
+                                                              index=index))
+        except Exception:
+            pass                   # introspection must never sink the run
+        last_numerics_it = it
+
     tokens_per_step = n_data * train_cfg.batch_size * train_cfg.seq_len
     t_start = None
     excluded_steps = warmup_steps_excluded
@@ -341,8 +394,14 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                     break
                 last_it = it
                 t_iter = time.perf_counter()
-                with _phase("dispatch", droot, "compute"):
-                    state, loss = step_fn(state, shard_fn(host_batch))
+                n_compiles = (len(compile_watch.compiles)
+                              if compile_watch is not None else 0)
+                with _phase("dispatch", droot, "compute") as csp:
+                    state, out = step_fn(state, shard_fn(host_batch))
+                    if (csp is not None and compile_watch is not None
+                            and len(compile_watch.compiles) > n_compiles):
+                        csp.attrs["compiled"] = True
+                loss, naux = introspect.split_step_output(out)
                 if it + 1 == start_step + warmup_steps_excluded:
                     float(loss)  # hard sync before starting the timer
                     t_start = time.perf_counter()
@@ -381,9 +440,17 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                             dt_s=now - last_event_t,
                             steps=it - last_event_it, **extra)
                         last_event_t, last_event_it = now, it
+                    if (naux is not None
+                            and it - last_numerics_it >= numerics_every):
+                        _emit_numerics(it, naux)
                     delta = report.resilience.delta(prev_counters)
                     if delta:
-                        telemetry.events.fault(counters=delta, it=it)
+                        # Forced numerics sample + guard attribution ride
+                        # ahead of / on the fault event, so the flight
+                        # recorder's dump (triggered by it) carries both.
+                        _emit_numerics(it, naux)
+                        telemetry.events.fault(counters=delta, it=it,
+                                               **_fault_extra(step_fn))
                         prev_counters = report.resilience.as_dict()
                 if ckpt is not None and (it + 1) % checkpoint_every == 0:
                     try:
@@ -448,8 +515,14 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                           else _window(it0, it1, droot))
                 staged = None
                 t_iter = time.perf_counter()
-                with _phase("dispatch", droot, "compute"):
-                    state, losses = step_fn(state, window_shard_fn(window))
+                n_compiles = (len(compile_watch.compiles)
+                              if compile_watch is not None else 0)
+                with _phase("dispatch", droot, "compute") as csp:
+                    state, out = step_fn(state, window_shard_fn(window))
+                    if (csp is not None and compile_watch is not None
+                            and len(compile_watch.compiles) > n_compiles):
+                        csp.attrs["compiled"] = True
+                losses, naux = introspect.split_step_output(out)
                 # Stage the NEXT chunk's host window while the device runs
                 # this one: under async dispatch the tokenize/stack work
                 # overlaps compute instead of serializing after it.
@@ -478,9 +551,16 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                             dt_s=now - last_event_t,
                             steps=last_it - last_event_it, **extra)
                         last_event_t, last_event_it = now, last_it
+                    if (naux is not None
+                            and last_it - last_numerics_it >= numerics_every):
+                        # Chunk-edge sampling: the stacked [K] summary's
+                        # LAST step stands for the chunk.
+                        _emit_numerics(last_it, naux, index=-1)
                     delta = report.resilience.delta(prev_counters)
                     if delta:
-                        telemetry.events.fault(counters=delta, it=last_it)
+                        _emit_numerics(last_it, naux, index=-1)
+                        telemetry.events.fault(counters=delta, it=last_it,
+                                               **_fault_extra(step_fn))
                         prev_counters = report.resilience.as_dict()
                 if first_chunk:
                     # Warmup exclusion quantized to the first chunk edge:
@@ -727,7 +807,8 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                     last_event_t, last_event_it = now, last_it
                 delta = report.resilience.delta(prev_counters)
                 if delta:
-                    telemetry.events.fault(counters=delta, it=last_it)
+                    telemetry.events.fault(counters=delta, it=last_it,
+                                           **_fault_extra(step_fn))
                     prev_counters = report.resilience.as_dict()
             if first_chunk:
                 float(losses[-1])   # sync: compile/replay stay untimed
@@ -903,6 +984,25 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     if spd < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
     elastic = bool(resilience is not None and resilience.elastic)
+    numerics = None
+    if train_cfg.numerics_every > 0:
+        # In-jit run-health numerics (telemetry/introspect.py): supported
+        # exactly where the shared step body lives — gradient/zero1 on the
+        # fp32 wire, non-elastic (the compressed steps own their collective
+        # schedules; the elastic rebuild path has no consumer yet). Hard
+        # errors, not silent no-ops: a chaos run that THINKS it is
+        # instrumented but isn't would produce attribution-free bundles.
+        if aggregation not in ("gradient", "zero1"):
+            raise ValueError("numerics_every requires gradient or zero1 "
+                             f"aggregation (got {aggregation!r})")
+        if train_cfg.wire != "fp32":
+            raise ValueError("numerics_every requires wire='fp32'")
+        if elastic:
+            raise ValueError("numerics_every does not compose with "
+                             "elastic mode yet")
+        numerics = introspect.make_summarizer(
+            params,
+            psum_axis="data" if aggregation == "zero1" else None)
     if elastic:
         # Elastic DP (resilience/elastic.py): the loop drives the [K, B, T]
         # window step (K = steps_per_dispatch, 1 included) so replica-loss
@@ -929,6 +1029,19 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                 fn = dp.make_multi_step(loss_fn, optimizer, m,
                                         accum_steps=train_cfg.accum_steps)
                 st = dp.replicate(m, dp.init_state(params, optimizer))
+            # Each (re)build gets its own CompileWatch: the post-remesh
+            # recompile is then a visible ``compile`` event in the stream,
+            # world-size-tagged — no retrace budget (tail chunks + remesh
+            # recompiles are legitimate).
+            fn = introspect.watch(
+                fn, name=f"train/dp-{aggregation}-elastic-w"
+                         f"{m.shape['data']}",
+                max_caches=None,
+                events=(telemetry.events if telemetry is not None
+                        else None),
+                meta={"steps_per_dispatch": spd},
+                meta_fn=lambda st, w: {"steps_per_dispatch":
+                                       int(w.shape[0])})
             return st, fn, (lambda w, m=m: dp.shard_batch_window(m, w))
     state = None
     if train_cfg.wire != "fp32":
@@ -963,19 +1076,22 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             state, step_fn, window_shard = _build_elastic(mesh)
         elif spd > 1:
             state, step_fn = dp.make_zero1_multi_step(loss_fn, optimizer,
-                                                      mesh, params)
+                                                      mesh, params,
+                                                      numerics=numerics)
         else:
             state, step_fn = dp.make_zero1_step(loss_fn, optimizer, mesh,
-                                                params)
+                                                params, numerics=numerics)
     elif aggregation == "gradient":
         if elastic:
             state, step_fn, window_shard = _build_elastic(mesh)
         elif spd > 1:
             step_fn = dp.make_multi_step(
-                loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
+                loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps,
+                numerics=numerics)
         else:
             step_fn = dp.make_grad_aggregation_step(
-                loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps)
+                loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps,
+                numerics=numerics)
     elif aggregation == "weight":
         if train_cfg.accum_steps != 1:
             raise ValueError("accum_steps needs gradient aggregation")
@@ -988,6 +1104,31 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                          "'gradient', 'weight' or 'zero1'")
     if state is None:
         state = dp.replicate(mesh, dp.init_state(params, optimizer))
+
+    if not elastic:
+        # Compile/retrace observability (introspect.CompileWatch): every
+        # XLA compilation of the hot-path step becomes a ``compile`` event
+        # (wall seconds, HLO flops/bytes for attainment, cache-hit vs
+        # retrace). Per-step mode promises ONE compiled program
+        # (max_caches=1 — growth past it is a retrace bug); chunked mode
+        # legitimately compiles a tail-chunk shape, so no budget there.
+        # The elastic path wraps inside _build_elastic instead (each
+        # re-mesh rebuild gets its own watch). Transparent to
+        # measure_comm/eval_shape — attribute access delegates.
+        step_fn = introspect.watch(
+            step_fn,
+            name=f"train/dp-{aggregation}" + (f"-k{spd}" if spd > 1 else ""),
+            max_caches=(1 if spd == 1 else None),
+            events=(telemetry.events if telemetry is not None else None),
+            # Chunked mode stamps each compile event with the COMPILING
+            # call's actual window size — a tail chunk's smaller program
+            # must not be normalized as a full-K one (slo_monitor's
+            # per-step MFU arithmetic divides flops by this).
+            meta={"steps_per_dispatch": spd},
+            meta_fn=(None if spd == 1 else
+                     (lambda st, w: {"steps_per_dispatch":
+                                     int(w.shape[0])})))
+    compile_watch = step_fn if not elastic else None
 
     stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
@@ -1043,7 +1184,10 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      warmup_steps_excluded=warmup_steps_excluded,
                      stats=stats, telemetry=telemetry,
                      steps_per_dispatch=spd,
-                     window_shard_fn=lambda w: dp.shard_batch_window(mesh, w))
+                     window_shard_fn=lambda w: dp.shard_batch_window(mesh, w),
+                     numerics=numerics,
+                     numerics_every=train_cfg.numerics_every,
+                     compile_watch=compile_watch)
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
@@ -1090,6 +1234,10 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         raise ValueError("steps_per_dispatch (fused multi-step dispatch) is "
                          "DP-trainer-only; the pipeline step owns its own "
                          "schedule")
+    if train_cfg.numerics_every != 0:
+        raise ValueError("numerics_every (in-jit numerics summaries) is "
+                         "DP-trainer-only; the pipeline step body is not "
+                         "instrumented")
     if resilience is not None and resilience.elastic:
         raise ValueError("elastic mode is DP-trainer-only: losing a replica "
                          "from a PP mesh orphans its stage partners — a "
@@ -1107,6 +1255,12 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     step_fn = pp.make_pipeline_step(model_cfg, optimizer, mesh,
                                     n_microbatches=train_cfg.microbatches,
                                     schedule=schedule)
+    # One compiled program per PP run — same compile/retrace accounting as
+    # the DP trainer (introspect.CompileWatch).
+    step_fn = introspect.watch(
+        step_fn, name=f"train/pp-{schedule}", max_caches=1,
+        events=(telemetry.events if telemetry is not None else None))
+    compile_watch = step_fn
 
     stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
@@ -1128,4 +1282,5 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                      sink_every=sink_every, log_every=log_every,
                      log_fn=log_fn,
                      warmup_steps_excluded=warmup_steps_excluded,
-                     stats=stats, telemetry=telemetry)
+                     stats=stats, telemetry=telemetry,
+                     compile_watch=compile_watch)

@@ -31,12 +31,24 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 
+# Attainment-style FIELDS on headline rows, promoted to their own
+# comparable rows. Higher is better for both (like every row here), but
+# they are only meaningful same-platform — an MFU measured against the
+# calibrated CPU baseline must NEVER gate against the TPU round-4 0.310
+# — so a derived row REQUIRES an explicit platform tag: a row without
+# one gets no derived entry rather than landing in a "None" bucket both
+# platforms would share.
+DERIVED_FIELDS = ("mfu", "attainment")
+
+
 def parse_rows(path: str) -> List[Dict[str, Any]]:
     """Headline rows from one file, tolerating all three shapes: the
     driver wrapper (``parsed``, plus any JSON lines in ``tail``), raw
     bench.py stdout (human lines interleaved with JSON rows), or a bare
     row object. A row is any JSON object with ``metric`` and a numeric
-    ``value``."""
+    ``value``. Rows carrying a numeric ``mfu``/``attainment`` field AND a
+    platform tag additionally yield a derived row per field (see
+    ``DERIVED_FIELDS``)."""
     with open(path) as f:
         text = f.read()
     rows: List[Dict[str, Any]] = []
@@ -45,6 +57,13 @@ def parse_rows(path: str) -> List[Dict[str, Any]]:
         if (isinstance(obj, dict) and "metric" in obj
                 and isinstance(obj.get("value"), (int, float))):
             rows.append(obj)
+            for fld in DERIVED_FIELDS:
+                v = obj.get(fld)
+                if (isinstance(v, (int, float)) and v > 0
+                        and obj.get("platform") is not None):
+                    rows.append({"metric": fld, "value": float(v),
+                                 "platform": obj["platform"],
+                                 "variant": obj.get("variant")})
 
     try:
         doc = json.loads(text)
@@ -69,6 +88,12 @@ def parse_rows(path: str) -> List[Dict[str, Any]]:
             seen.add(key)
             out.append(r)
     return out
+
+
+def _fmt_val(v: float) -> str:
+    """Throughput rows are 6-digit integers; derived mfu/attainment rows
+    live in [0, 1] — one format hides the latter as 0.0."""
+    return f"{v:>14,.1f}" if abs(v) >= 10 else f"{v:>14.4f}"
 
 
 def row_key(row: Dict[str, Any]) -> Tuple[str, str, str]:
@@ -105,7 +130,7 @@ def compare(files: List[str], candidate: Optional[str],
         for name, value in traj:
             delta = ("" if prev in (None, 0)
                      else f"  ({100 * (value - prev) / prev:+.1f}%)")
-            lines.append(f"  {name:24s} {value:>14,.1f}{delta}")
+            lines.append(f"  {name:24s} {_fmt_val(value)}{delta}")
             prev = value
         judged = cand_rows.get(key)
         baseline_pool = traj
@@ -123,12 +148,12 @@ def compare(files: List[str], candidate: Optional[str],
                     f"{value:,.1f} is {-delta_pct:.1f}% below best "
                     f"committed {best:,.1f} ({best_name}) — budget "
                     f"{max_regression_pct:.0f}%")
-            lines.append(f"  {name:24s} {value:>14,.1f}  "
+            lines.append(f"  {name:24s} {_fmt_val(value)}  "
                          f"({delta_pct:+.1f}% vs best {best_name}) "
                          f"[{verdict}]")
         elif judged is not None:
             name, value = judged
-            lines.append(f"  {name:24s} {value:>14,.1f}  "
+            lines.append(f"  {name:24s} {_fmt_val(value)}  "
                          "(no comparable committed row — new "
                          "platform/variant, nothing to judge against)")
     return lines, regressions

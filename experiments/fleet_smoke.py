@@ -149,6 +149,23 @@ def run(a) -> dict:
     checks["krum_streamed_selection_matches"] = bool(
         (np.sort(sel_stream) == np.sort(sel_vmap)).all())
 
+    # Retrace detector (ISSUE 9): every cohort step in this smoke — the
+    # 100k-client streamed round, the control-slice server, the two-tier
+    # round, the defended collect path — promises ONE compiled program
+    # (ragged cohorts pad; raggedness is data). CompileWatch counts any
+    # budget violation; the _cache_size()==1 invariant is the same claim
+    # read off the jit cache directly. kdef._collect_step is excluded ON
+    # PURPOSE: the vmapped-reference parity call above feeds it the FULL
+    # control slice (a deliberate second shape — test scaffolding, not the
+    # streamed path, which went through _collect_edge at cohort width).
+    checks["zero_retraces"] = (
+        all(s._stream_step.retraces == 0 and s._secagg_step.retraces == 0
+            for s in (server, ctl_stream, hier, kdef))
+        and all(s._collect_step.retraces == 0
+                for s in (server, ctl_stream, hier)))
+    checks["one_trace_per_stream_step"] = (
+        server._stream_step._cache_size() == 1)
+
     # Selection-cost probe: Multi-Krum's O(n²·P) distance matrix at a
     # client count where it bites, vs a course-scale count for contrast.
     krum_probe = {}

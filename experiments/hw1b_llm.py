@@ -37,17 +37,20 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                 checkpoint_dir: str = None, faults: str = "",
                 fault_seed: int = 0, guard: bool = False,
                 telemetry_dir: str = None, steps_per_dispatch: int = 1,
-                zero1: bool = False, elastic: bool = False) -> Dict[str, float]:
+                zero1: bool = False, elastic: bool = False,
+                numerics_every: int = 0) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
-    if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1 or elastic):
+    if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1 or elastic
+                              or numerics_every):
         # These levers are DP-trainer-only (the PP step owns its
         # own schedule/collectives); failing loudly beats silently timing
         # the wrong program.
-        raise ValueError(f"--steps-per-dispatch/--zero1/--elastic need a DP "
-                         f"config (got {name})")
+        raise ValueError(f"--steps-per-dispatch/--zero1/--elastic/"
+                         f"--numerics-every need a DP config (got {name})")
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
+                            numerics_every=numerics_every,
                             **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
     label = f"{name}_b{train_cfg.data * train_cfg.batch_size}_seq256_adam8e-4"
@@ -137,7 +140,8 @@ def main(quick: bool = False, iters: int = 5000,
          checkpoint_dir: str = None, faults: str = "",
          fault_seed: int = 0, guard: bool = False,
          telemetry_dir: str = None, steps_per_dispatch: int = 1,
-         zero1: bool = False, elastic: bool = False) -> Dict[str, float]:
+         zero1: bool = False, elastic: bool = False,
+         numerics_every: int = 0) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -166,7 +170,8 @@ def main(quick: bool = False, iters: int = 5000,
                                fault_seed=fault_seed, guard=guard,
                                telemetry_dir=telemetry_dir,
                                steps_per_dispatch=steps_per_dispatch,
-                               zero1=zero1, elastic=elastic))
+                               zero1=zero1, elastic=elastic,
+                               numerics_every=numerics_every))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -215,6 +220,12 @@ if __name__ == "__main__":
                          "reduce-scatter grads, Adam on each replica's 1/N "
                          "slice, all-gather params; DP configs only — "
                          "composes with --steps-per-dispatch)")
+    ap.add_argument("--numerics-every", type=int, default=0,
+                    help="in-jit numerics summaries (telemetry/"
+                         "introspect.py): emit a per-layer-group "
+                         "grad/param/update-norm event every N steps; "
+                         "0 disables (DP configs only; bitwise-free — "
+                         "losses identical on vs off)")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic DP (resilience/elastic.py): survive "
                          "replica loss (inject with --faults "
@@ -235,4 +246,4 @@ if __name__ == "__main__":
          fault_seed=a.fault_seed, guard=a.guard,
          telemetry_dir=a.telemetry_dir,
          steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1,
-         elastic=a.elastic)
+         elastic=a.elastic, numerics_every=a.numerics_every)

@@ -24,6 +24,7 @@ import time
 # is used makes the no-jax contract explicit).
 from ddl25spring_tpu.telemetry.events import iter_runs, read_events
 from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
+from ddl25spring_tpu.telemetry.introspect import attainment
 from ddl25spring_tpu.telemetry.registry import percentile
 from ddl25spring_tpu.telemetry.trace import trace_trees, tree_check
 
@@ -153,6 +154,123 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                   if isinstance(e.get("blocks_in_use"), int)]
         if blocks:
             print(f"peak blocks in use: {max(blocks)}")
+
+    nums = by_type.get("numerics", [])
+    if nums:
+        # Numerics section (schema v5, telemetry/introspect.py): the
+        # in-jit run-health samples. Pre-v5 streams simply have no
+        # ``numerics`` events and skip this silently.
+        _section("numerics (in-jit run health)")
+        gnorms = [e["grad_norm"] for e in nums
+                  if isinstance(e.get("grad_norm"), (int, float))]
+        print(f"samples: {len(nums)}   iters "
+              f"{nums[0].get('it')}..{nums[-1].get('it')}"
+              + (f"   grad_norm {_fmt_num(gnorms[0])} -> "
+                 f"{_fmt_num(gnorms[-1])}" if gnorms else ""))
+        # Worst-drifting layer group: widest max/min spread of the
+        # update/param ratio across the run's samples — the knob that
+        # moves before a spike becomes a StepGuard skip.
+        spread = {}
+        for e in nums:
+            for g, d in (e.get("groups") or {}).items():
+                r = d.get("update_ratio")
+                if isinstance(r, (int, float)) and r > 0:
+                    lo, hi = spread.get(g, (r, r))
+                    spread[g] = (min(lo, r), max(hi, r))
+        drifts = sorted(((hi / lo, g, lo, hi)
+                         for g, (lo, hi) in spread.items() if lo > 0),
+                        reverse=True)
+        for d, g, lo, hi in drifts[:3]:
+            print(f"  {g:16s} update/param ratio {lo:.3g} .. {hi:.3g} "
+                  f"(x{d:.2f} drift)")
+        bad = [e for e in nums if e.get("nonfinite_grads")]
+        for e in bad:
+            print(f"  it {e.get('it', '?'):>6}: NON-FINITE grads in "
+                  f"{e['nonfinite_grads']}   <-- BAD")
+
+    compiles = by_type.get("compile", [])
+    if compiles:
+        # Compile/retrace section (schema v5, introspect.CompileWatch).
+        _section("compile / retrace")
+        by_name = {}
+        for e in compiles:
+            agg = by_name.setdefault(e.get("name", "?"),
+                                     {"n": 0, "s": 0.0, "retraces": 0,
+                                      "flops": None, "bytes": None})
+            agg["n"] += 1
+            if isinstance(e.get("seconds"), (int, float)):
+                agg["s"] += e["seconds"]
+            if e.get("retrace"):
+                agg["retraces"] += 1
+            if isinstance(e.get("flops"), (int, float)):
+                agg["flops"] = e["flops"]
+            if isinstance(e.get("bytes_accessed"), (int, float)):
+                agg["bytes"] = e["bytes_accessed"]
+        for name, agg in sorted(by_name.items()):
+            line = (f"  {name:28s} compiles {agg['n']:<3d} "
+                    f"{agg['s']:8.2f}s total")
+            if agg["flops"]:
+                line += f"  {agg['flops'] / 1e6:,.1f} MFLOP/dispatch"
+            if agg["retraces"]:
+                line += f"  RETRACES {agg['retraces']}   <-- BAD"
+            print(line)
+
+    peaks = (manifest or {}).get("peaks")
+    if compiles and peaks:
+        # Attainment section: what each dispatch ACHIEVED vs the roofline
+        # peaks the manifest recorded (ROOFLINE.md numbers on chip, the
+        # calibrated baseline on CPU fallback). Numerators: the compiled
+        # program's HLO flops/bytes normalized PER STEP by the compile
+        # event's own steps_per_dispatch (same rule as slo_monitor — a
+        # ragged tail chunk's smaller program must not be costed as a
+        # full-K one), then scaled by each dispatch's step count (the
+        # parent ``dispatch`` span's ``steps``); denominator: the
+        # ``compute`` span durations.
+        prog = next((e for e in reversed(compiles)
+                     if isinstance(e.get("flops"), (int, float))
+                     and e["flops"] > 0), None)
+        span_events = by_type.get("span", [])
+        by_span_id = {e.get("span_id"): e for e in span_events}
+        # ``compiled``-stamped spans (the trainer marks a dispatch whose
+        # call compiled — warmup, tail-chunk shapes) are excluded: a
+        # compile-dominated interval is not an attainment sample.
+        computes = [e for e in span_events
+                    if e.get("name") == "compute"
+                    and not e.get("compiled")
+                    and isinstance(e.get("dur_ns"), (int, float))
+                    and e["dur_ns"] > 0]
+        if prog is not None and computes:
+            _section("attainment (vs roofline peaks)")
+            spd = prog.get("steps_per_dispatch")
+            spd = spd if isinstance(spd, int) and spd > 0 else 1
+            flops_step = prog["flops"] / spd
+            bytes_step = (prog["bytes_accessed"] / spd
+                          if isinstance(prog.get("bytes_accessed"),
+                                        (int, float)) else None)
+            mfus, gbs = [], []
+            for s in computes:
+                parent = by_span_id.get(s.get("parent_span_id"), {})
+                steps = parent.get("steps")
+                steps = steps if isinstance(steps, int) and steps > 0 else 1
+                att = attainment(flops_step * steps,
+                                 (bytes_step * steps
+                                  if bytes_step is not None else None),
+                                 s["dur_ns"] / 1e9, peaks)
+                if att["mfu"] is not None:
+                    mfus.append(att["mfu"])
+                if att["bytes_per_sec"] is not None:
+                    gbs.append(att["bytes_per_sec"] / 1e9)
+            print(f"program: {prog.get('name')}   "
+                  f"{flops_step / 1e6:,.1f} MFLOP/step   "
+                  f"peaks: {peaks.get('source', '?')}")
+            if mfus:
+                print("mfu: " + "  ".join(
+                    f"p{q:g}={percentile(mfus, q):.4f}"
+                    for q in (50, 99)) + f"  n={len(mfus)} dispatches")
+            if gbs:
+                print("memory: " + "  ".join(
+                    f"p{q:g}={percentile(gbs, q):.2f} GB/s"
+                    for q in (50, 99)))
 
     spans = by_type.get("span", [])
     if spans:

@@ -1,0 +1,155 @@
+"""Render anomaly flight-recorder bundles into a triage report.
+
+The read side of the flight recorder (telemetry/introspect.py): a
+``Telemetry`` run dumps a self-contained postmortem JSON bundle under
+``<telemetry-dir>/postmortem/`` the moment a ``fault``/``remesh``/
+``slo_violation`` event crosses its stream. This tool — pure stdlib,
+never imports jax — finds the bundles under a path and prints, per
+bundle: what tripped, WHICH tree path carried the NaN (the StepGuard
+attribution), the numerics state at the trip (worst-drifting layer
+group, grad norms), the compile/retrace record, and the tail of recent
+events. The triage recipe lives in docs/COMPONENTS.md ("Run health").
+
+Exit codes: 0 bundles found and rendered; 2 none found (CI's chaos step
+treats that as "the fault injection produced no postmortem" — a failure
+of the machinery under test, not of this renderer); with ``--expect-leaf``
+additionally 1 when no bundle names the given leaf path fragment.
+
+Example:
+    python -m experiments.hw1b_llm --cpu --quick --configs dp1 \\
+        --faults nan_grad@8 --guard --numerics-every 4 \\
+        --telemetry-dir /tmp/chaos
+    python -m experiments.postmortem /tmp/chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ddl25spring_tpu.telemetry.introspect import find_bundles, load_bundle
+
+
+def _fmt(v) -> str:
+    return f"{v:.4g}" if isinstance(v, (int, float)) else str(v)
+
+
+def render_bundle(bundle: dict, out=sys.stdout) -> None:
+    p = lambda s="": print(s, file=out)  # noqa: E731
+    reason = bundle.get("reason", "?")
+    t = bundle.get("t")
+    when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+            if isinstance(t, (int, float)) else "?")
+    p(f"== postmortem: {reason} @ {when}  (run {bundle.get('run_id')}) "
+      + "=" * 10)
+
+    trigger = bundle.get("trigger") or {}
+    if trigger:
+        head = {k: v for k, v in trigger.items()
+                if k not in ("schema", "run_id", "seq", "attribution")}
+        p(f"trigger: {json.dumps(head, default=str)[:300]}")
+    attribution = bundle.get("attribution") or trigger.get("attribution")
+    if attribution:
+        paths = attribution.get("nonfinite_params") or []
+        p("attribution:"
+          + (f" NON-FINITE leaves {paths}" if paths else "")
+          + (" anomalous-update-norm" if attribution.get("anomalous")
+             else "")
+          + (f" update_norm={_fmt(attribution.get('update_norm'))}"))
+
+    man = bundle.get("manifest") or {}
+    if man:
+        p(f"run: trainer={man.get('trainer')} platform={man.get('platform')}"
+          f" mesh={man.get('mesh')} start_step={man.get('start_step')}")
+
+    nums = bundle.get("last_numerics") or {}
+    if nums:
+        p(f"numerics @ it {nums.get('it')}: grad_norm "
+          f"{_fmt(nums.get('grad_norm'))}  worst group "
+          f"{nums.get('worst_group')} (update/param "
+          f"{_fmt(nums.get('worst_update_ratio'))})")
+        if nums.get("nonfinite_grads"):
+            p(f"  in-jit NON-FINITE grads: {nums['nonfinite_grads']}")
+
+    compiles = bundle.get("compiles") or []
+    if compiles:
+        retraces = [c for c in compiles if c.get("retrace")]
+        p(f"compiles: {len(compiles)}"
+          + (f"   RETRACES {len(retraces)}: "
+             f"{[c.get('name') for c in retraces]}   <-- BAD"
+             if retraces else ""))
+
+    ring = bundle.get("recent_events") or []
+    dropped = bundle.get("dropped_events", 0)
+    p(f"recent events: {len(ring)} in ring"
+      + (f" ({dropped} older dropped to fit the size cap)" if dropped
+         else ""))
+    for e in ring[-8:]:
+        brief = {k: e.get(k) for k in ("type", "it", "loss", "slo", "name",
+                                       "counters", "old_world", "new_world")
+                 if e.get(k) is not None}
+        p(f"  seq {e.get('seq', '?'):>5}  {json.dumps(brief, default=str)[:140]}")
+    p()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="a bundle .json, a telemetry dir, or any "
+                                 "dir to search for postmortem-*.json under")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable summary list instead of "
+                         "the human report")
+    ap.add_argument("--expect-leaf", default=None,
+                    help="exit 1 unless some bundle's attribution names a "
+                         "leaf path containing this fragment (the chaos "
+                         "smoke's self-check)")
+    a = ap.parse_args(argv)
+
+    if a.path.endswith(".json"):
+        paths = [a.path]
+    else:
+        paths = find_bundles(a.path)
+    if not paths:
+        print(f"no postmortem bundles under {a.path}", file=sys.stderr)
+        return 2
+
+    bundles = []
+    for p in paths:
+        try:
+            bundles.append((p, load_bundle(p)))
+        except (OSError, ValueError) as e:
+            print(f"{p}: unreadable ({e})", file=sys.stderr)
+    if not bundles:
+        return 2
+
+    if a.json:
+        summary = [{
+            "path": p,
+            "reason": b.get("reason"),
+            "run_id": b.get("run_id"),
+            "attribution": b.get("attribution"),
+            "events": len(b.get("recent_events") or []),
+        } for p, b in bundles]
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        for p, b in bundles:
+            print(f"-- {p}")
+            render_bundle(b)
+
+    if a.expect_leaf is not None:
+        named = any(
+            a.expect_leaf in path
+            for _, b in bundles
+            for path in ((b.get("attribution") or {})
+                         .get("nonfinite_params") or []))
+        if not named:
+            print(f"no bundle attributes a non-finite leaf matching "
+                  f"{a.expect_leaf!r}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

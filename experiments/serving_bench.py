@@ -151,6 +151,11 @@ def run(a) -> dict:
 
     checks["pool_never_exceeded"] = (report.peak_blocks_in_use
                                      <= report.pool_blocks)
+    # Retrace detector (ISSUE 9): the engine compiles exactly its two
+    # programs and NEVER retraces — admission/retirement/raggedness are
+    # data. A retrace here means a shape leaked into a compiled step.
+    checks["zero_retraces"] = report.retraces == 0
+    checks["two_compiled_programs"] = report.compiles == 2
     # Memory bar, two forms: the CONFIG-level inequality (pool < the slots
     # × max_len caches generate() would allocate for the same concurrency
     # ceiling) holds at any load; the observed-peak form only demonstrates
@@ -175,6 +180,8 @@ def run(a) -> dict:
         "naive_bytes_at_peak": report.naive_bytes_at_peak,
         "naive_peak_blocks": naive_peak_blocks,
         "wall_s": round(wall, 3),
+        "compiles": report.compiles,
+        "retraces": report.retraces,
         "verified_bitwise": len(sample),
         "parity_mismatches": mismatches,
         "span_tree_problems": (tree_problems if events else None),

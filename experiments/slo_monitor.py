@@ -18,7 +18,22 @@ Objectives (each enabled by passing its threshold):
   (``fault`` counter deltas / ``step`` event step counts);
 - ``--heartbeat-stale``  seconds since the heartbeat moved (live mode
   reads heartbeat.json next to the stream; check mode compares the last
-  beat to the last event).
+  beat to the last event);
+- ``--slo-mfu``    MFU floor over the window — achieved FLOP/s from the
+  ``compile`` events' HLO flops (normalized per step by each event's own
+  ``steps_per_dispatch``, so ragged tail-chunk programs don't skew the
+  window) × the window's step count ÷ the window's step time, against
+  the manifest's recorded roofline peaks (ROOFLINE.md numbers on chip,
+  the calibrated CPU baseline on fallback; schema v5). Caveat, same as
+  bench.py's FLOP crosscheck: on jaxlibs whose ``cost_analysis`` counts
+  a ``lax.scan`` body once (this container's 0.4.36), a fused K-step
+  program's flops read as ONE step's, so chunked-mode MFU is biased low
+  by ~K — set the floor from the same stream's observed steady-state
+  values, not from first principles;
+- ``--slo-gradnorm``  grad-norm spike-rate ceiling: the fraction of the
+  window's ``numerics`` samples whose global grad norm exceeds
+  ``--gradnorm-factor`` × the window median (the drift signal that
+  precedes a StepGuard skip).
 
 Two modes:
 - **live** (default): follow the growing file (incremental reads, torn
@@ -50,6 +65,7 @@ from typing import Any, Dict, List, Optional
 
 from ddl25spring_tpu.telemetry.events import EventLog, read_events
 from ddl25spring_tpu.telemetry.heartbeat import read_heartbeat
+from ddl25spring_tpu.telemetry.introspect import FlightRecorder
 from ddl25spring_tpu.telemetry.registry import percentile
 
 
@@ -132,6 +148,10 @@ class SLOConfig:
     min_tokens_per_sec: Optional[float] = None
     max_skip_rate: Optional[float] = None
     heartbeat_stale_s: Optional[float] = None
+    # Run-health objectives (schema v5 numerics/compile events).
+    min_mfu: Optional[float] = None
+    max_gradnorm_spike_rate: Optional[float] = None
+    gradnorm_spike_factor: float = 10.0
 
 
 class SLOMonitor:
@@ -154,6 +174,17 @@ class SLOMonitor:
         self.first_token_t: Optional[float] = None
         self._skips: deque = deque()    # (t, count)
         self._steps: deque = deque()    # (t, count)
+        # Run-health state (schema v5): dispatch timing from non-warmup
+        # step events, program flops from compile events, peaks from the
+        # manifest, grad norms from numerics samples. Flops are held
+        # PER STEP — each compile event's flops divided by the step count
+        # that event itself carries — so a tail-chunk program (smaller
+        # flops AND smaller window) normalizes the same as the full-K one
+        # and last-compile-wins cannot skew the floor.
+        self._dts: deque = deque()      # (t, steps, dt_s)
+        self._gradnorms: deque = deque()  # (t, grad_norm)
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
         self.enqueued = 0
         self.done = 0
         self.run_ended = False
@@ -209,13 +240,29 @@ class SLOMonitor:
                 steps = e.get("steps")
                 if isinstance(steps, int) and steps > 0:
                     self._steps.append((t, steps))
+                    if (not e.get("warmup")
+                            and isinstance(e.get("dt_s"), (int, float))
+                            and e["dt_s"] > 0):
+                        self._dts.append((t, steps, e["dt_s"]))
+            elif etype == "manifest":
+                peaks = e.get("peaks") or {}
+                if isinstance(peaks.get("flops_per_sec"), (int, float)):
+                    self._peak_flops = peaks["flops_per_sec"]
+            elif etype == "compile":
+                if isinstance(e.get("flops"), (int, float)) and e["flops"] > 0:
+                    spd = e.get("steps_per_dispatch")
+                    spd = spd if isinstance(spd, int) and spd > 0 else 1
+                    self._flops_per_step = e["flops"] / spd
+            elif etype == "numerics":
+                if isinstance(e.get("grad_norm"), (int, float)):
+                    self._gradnorms.append((t, e["grad_norm"]))
             elif etype == "run_end":
                 self.run_ended = True
 
     def _prune(self, now: float) -> None:
         horizon = now - self.cfg.window_s
         for dq in (self._ttft, self._wait, self._tokens, self._skips,
-                   self._steps):
+                   self._steps, self._dts, self._gradnorms):
             while dq and dq[0][0] < horizon:
                 dq.popleft()
 
@@ -257,6 +304,32 @@ class SLOMonitor:
                 v = sum(n for _, n in self._tokens) / span
                 if v < cfg.min_tokens_per_sec:
                     measured["tokens_per_sec"] = (v, cfg.min_tokens_per_sec)
+        if (cfg.min_mfu is not None and self._dts
+                and self._flops_per_step and self._peak_flops):
+            # Achieved FLOP/s over the window's step events: per-step
+            # program flops × steps ÷ step seconds (per-step, so chunked
+            # runs with ragged tail programs normalize correctly).
+            steps = sum(s for _, s, _ in self._dts)
+            secs = sum(d for _, _, d in self._dts)
+            if secs > 0 and steps > 0:
+                v = (self._flops_per_step * steps / secs
+                     / self._peak_flops)
+                if v < cfg.min_mfu:
+                    measured["mfu"] = (v, cfg.min_mfu)
+        if (cfg.max_gradnorm_spike_rate is not None
+                and len(self._gradnorms) >= 4):
+            # Spike = a sample above factor × the window MEDIAN (robust
+            # to the spikes themselves); at least 4 samples so a lone
+            # sample can never be its own baseline.
+            norms = sorted(x for _, x in self._gradnorms)
+            median = norms[len(norms) // 2]
+            if median > 0:
+                spikes = sum(x > cfg.gradnorm_spike_factor * median
+                             for _, x in self._gradnorms)
+                v = spikes / len(self._gradnorms)
+                if v > cfg.max_gradnorm_spike_rate:
+                    measured["gradnorm_spike_rate"] = (
+                        v, cfg.max_gradnorm_spike_rate)
         if cfg.max_skip_rate is not None and self._skips:
             steps = sum(n for _, n in self._steps)
             skips = sum(n for _, n in self._skips)
@@ -336,6 +409,17 @@ def main(argv=None) -> int:
                     help="StepGuard skipped-steps / steps ceiling")
     ap.add_argument("--heartbeat-stale", type=float, default=None,
                     help="heartbeat age ceiling (s)")
+    ap.add_argument("--slo-mfu", type=float, default=None,
+                    help="MFU floor over the window (achieved FLOP/s from "
+                         "compile-event flops + step timing, vs the "
+                         "manifest's roofline peaks)")
+    ap.add_argument("--slo-gradnorm", type=float, default=None,
+                    help="grad-norm spike-rate ceiling (fraction of the "
+                         "window's numerics samples above "
+                         "--gradnorm-factor x the window median)")
+    ap.add_argument("--gradnorm-factor", type=float, default=10.0,
+                    help="spike threshold multiple of the window-median "
+                         "grad norm")
     ap.add_argument("--poll", type=float, default=2.0,
                     help="live mode: seconds between evaluations")
     ap.add_argument("--duration", type=float, default=None,
@@ -360,13 +444,30 @@ def main(argv=None) -> int:
                     queue_p99_s=a.queue_p99,
                     min_tokens_per_sec=a.min_tps,
                     max_skip_rate=a.max_skip_rate,
-                    heartbeat_stale_s=a.heartbeat_stale)
+                    heartbeat_stale_s=a.heartbeat_stale,
+                    min_mfu=a.slo_mfu,
+                    max_gradnorm_spike_rate=a.slo_gradnorm,
+                    gradnorm_spike_factor=a.gradnorm_factor)
     emit_default = not a.check
     emit = a.emit if a.emit is not None else emit_default
     # heal=False: we are a SIDECAR on a possibly-LIVE stream — append
     # only, never truncate what might be another writer's in-flight line.
     log = (EventLog(events_path, run_id=f"slo-{os.getpid()}", heal=False)
            if emit else None)
+    if log is not None:
+        # Arm a flight recorder in THIS process (the run's own recorder
+        # only sees events its process emits — a sidecar's violation
+        # never crosses that tap): every tailed event feeds the ring, and
+        # the violation we emit dumps a postmortem bundle next to the
+        # run's own (triggers narrowed to slo_violation so a fault the
+        # trainer already bundled is not bundled twice).
+        recorder = FlightRecorder(
+            os.path.join(os.path.dirname(events_path) or ".",
+                         "postmortem"),
+            triggers=("slo_violation",))
+        log.observers.append(recorder.observe)
+    else:
+        recorder = None
 
     def _hb():
         return (read_heartbeat(heartbeat_path)
@@ -376,14 +477,21 @@ def main(argv=None) -> int:
         if not os.path.exists(events_path):
             print(f"no event stream at {events_path}", file=sys.stderr)
             return 2
-        violations = check_stream(read_events(events_path), cfg,
-                                  heartbeat=_hb(), emit=log)
+        events = read_events(events_path)
+        if recorder is not None:
+            for e in events:          # bundle context; never re-triggers
+                recorder.ingest(e)
+        violations = check_stream(events, cfg, heartbeat=_hb(), emit=log)
     else:
         tailer = StreamTailer(events_path)
         monitor = SLOMonitor(cfg, emit=log)
         t0 = time.time()
         while True:
-            monitor.feed(tailer.poll())
+            fresh = tailer.poll()
+            if recorder is not None:
+                for e in fresh:       # bundle context; never re-triggers
+                    recorder.ingest(e)
+            monitor.feed(fresh)
             for v in monitor.evaluate(time.time(), _hb()):
                 print(f"[slo] VIOLATION {v['slo']}: {v['value']:.4g} vs "
                       f"threshold {v['threshold']:.4g} "

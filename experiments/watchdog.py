@@ -142,6 +142,13 @@ def main() -> int:
                          "outstanding")
     ap.add_argument("--slo-max-skip-rate", type=float, default=None,
                     help="StepGuard skipped-steps/steps ceiling")
+    ap.add_argument("--slo-mfu", type=float, default=None,
+                    help="MFU floor over the window (schema v5 "
+                         "compile/step events vs the manifest's roofline "
+                         "peaks — slo_monitor's --slo-mfu)")
+    ap.add_argument("--slo-gradnorm", type=float, default=None,
+                    help="grad-norm spike-rate ceiling over the window's "
+                         "numerics samples (slo_monitor's --slo-gradnorm)")
     ap.add_argument("--slo-grace", type=float, default=0.0,
                     help="kill+relaunch after this many seconds of "
                          "SUSTAINED SLO breach (0 = log violations only)")
@@ -174,7 +181,9 @@ def main() -> int:
                             ttft_p99_s=a.slo_ttft_p99,
                             queue_p99_s=a.slo_queue_p99,
                             min_tokens_per_sec=a.slo_min_tps,
-                            max_skip_rate=a.slo_max_skip_rate)
+                            max_skip_rate=a.slo_max_skip_rate,
+                            min_mfu=a.slo_mfu,
+                            max_gradnorm_spike_rate=a.slo_gradnorm)
     for attempt in range(a.max_restarts + 1):
         print(f"[watchdog] attempt {attempt}: {' '.join(cmd)}", flush=True)
         launched = time.time()
