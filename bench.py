@@ -79,7 +79,8 @@ def peak_flops_per_chip() -> float:
 
 def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused",
                wire=None, steps_per_dispatch: int = 1,
-               aggregation: str = "gradient") -> float:
+               aggregation: str = "gradient",
+               overlap_microbatches: int = 0) -> float:
     """Tokens/sec for the DP train step at the given per-chip batch size.
 
     ``opt_name``: "fused" = single-pass fused Adam (ops/adam.py — same update
@@ -92,12 +93,15 @@ def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused",
     ``steps_per_dispatch`` > 1 selects the fused K-step scan driver and
     ``aggregation="zero1"`` the sharded weight update (parallel/dp.py) —
     the PR-3 hot-path levers, swept as their own variant rows.
+    ``overlap_microbatches`` >= 1 routes through the overlapped ring
+    driver (parallel/compress.py), composing ``wire`` with both.
     """
     from ddl25spring_tpu.bench_utils import time_train_step
     return time_train_step(mesh, cfg, batch_size, seq=SEQ, opt_name=opt_name,
                            wire=wire, warmup=WARMUP, timed_steps=TIMED_STEPS,
                            steps_per_dispatch=steps_per_dispatch,
-                           aggregation=aggregation)
+                           aggregation=aggregation,
+                           overlap_microbatches=overlap_microbatches)
 
 
 def _time_batch_one(overrides_json: str, batch: str) -> None:
@@ -120,6 +124,7 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
     wire = overrides.pop("_wire", None)
     spd = overrides.pop("_spd", 1)
     agg = overrides.pop("_agg", "gradient")
+    ovl = overrides.pop("_ovl", 0)
     if opt_name == "pallas":
         # Gate the '+padam' number on a real-lowering smoke: interpret-mode
         # CPU tests validate the math, not the Mosaic compile. A broken
@@ -130,7 +135,8 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
     n_dev = len(jax.devices())
     mesh = make_mesh({"data": n_dev})
     print(time_batch(mesh, cfg, int(batch), opt_name=opt_name, wire=wire,
-                     steps_per_dispatch=spd, aggregation=agg),
+                     steps_per_dispatch=spd, aggregation=agg,
+                     overlap_microbatches=ovl),
           n_dev)
 
 
@@ -315,7 +321,20 @@ def main():
                         ({**flash_overrides, "_spd": 4},
                          "flash-dhm+scan4", (64,)),
                         ({**flash_overrides, "_spd": 4, "_agg": "zero1"},
-                         "flash-dhm+zero1scan4", (64,))]
+                         "flash-dhm+zero1scan4", (64,)),
+                        # Overlapped+compressed sync (parallel/compress.py
+                        # ring driver): int8 in-flight ring chunks + int8
+                        # delta gather at zero1 memory inside the K-step
+                        # scan — the ACCO/EQuARX composition row. M=2
+                        # additionally overlaps microbatch compute with
+                        # the previous microbatch's ring (wire scales
+                        # with M; the M=1 row is the wire-minimal point).
+                        ({**flash_overrides, "_spd": 4, "_agg": "zero1",
+                          "_wire": "int8_ef", "_ovl": 1},
+                         "flash-dhm+int8ring-z1k4", (64,)),
+                        ({**flash_overrides, "_spd": 4, "_agg": "zero1",
+                          "_wire": "int8_ef", "_ovl": 2},
+                         "flash-dhm+acco-m2", (64,))]
         for overrides, label, batches in pallas_sweep:
             for bs in batches:
                 try:
@@ -357,7 +376,16 @@ def main():
         sweep = [({"softmax_dtype": "float32"}, "f32", (8,)),
                  ({"softmax_dtype": "float32", "_spd": 8},
                   "f32+scan8", (8,)),
-                 ({"dtype": "float32", "_spd": 8}, "f32c+scan8", (8,))]
+                 ({"dtype": "float32", "_spd": 8}, "f32c+scan8", (8,)),
+                 # The overlapped ring driver composed end to end (int8
+                 # in-flight chunks + int8 delta gather at zero1 memory
+                 # inside the K-step scan): on one CPU device the ring is
+                 # a no-op hop-wise, so this times the quantize/EF math's
+                 # overhead riding the fused dispatch — the single-host
+                 # datum next to the multi-host wire design.
+                 ({"dtype": "float32", "_spd": 8, "_agg": "zero1",
+                   "_wire": "int8_ef", "_ovl": 1},
+                  "f32c+int8ring-z1k8", (8,))]
     else:
         # bf16 scores: the documented XLA-path throughput knob.
         # attention_impl pinned to "xla": the config default ("auto") now
@@ -374,11 +402,14 @@ def main():
         ov = dict(overrides)               # reserved keys, not cfg fields
         spd = ov.pop("_spd", 1)
         agg = ov.pop("_agg", "gradient")
+        wire = ov.pop("_wire", None)
+        ovl = ov.pop("_ovl", 0)
         cfg = dataclasses.replace(base, **ov)
         for bs in batches:
             try:
                 tps = time_batch(mesh, cfg, bs, steps_per_dispatch=spd,
-                                 aggregation=agg)
+                                 aggregation=agg, wire=wire,
+                                 overlap_microbatches=ovl)
             except Exception as e:  # one variant must not sink the sweep
                 print(f"batch {bs:4d} attn={label:10s}: failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr)

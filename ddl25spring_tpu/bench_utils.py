@@ -49,7 +49,8 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
                     wire: Optional[str] = None,
                     warmup: int = 3, timed_steps: int = 20,
                     steps_per_dispatch: int = 1,
-                    aggregation: str = "gradient") -> float:
+                    aggregation: str = "gradient",
+                    overlap_microbatches: int = 0) -> float:
     """Total tokens/sec of the DP train step at the given per-chip batch.
 
     ``seq`` defaults to ``cfg.ctx_size``. The caller divides by its device
@@ -65,21 +66,35 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
     comparable with the per-step rows while the dispatch overhead is paid
     once per window. ``aggregation`` ∈ {"gradient", "zero1"} picks the
     plain pmean path or the ZeRO-1 sharded weight update; both compose
-    with ``steps_per_dispatch`` (``make_zero1_multi_step``), neither with
-    ``wire``."""
+    with ``steps_per_dispatch`` (``make_zero1_multi_step``).
+
+    ``overlap_microbatches`` = M >= 1 times the overlapped ring driver
+    (parallel/compress.py ``make_overlap_*``) instead — the path where
+    ``wire`` (fp32/bf16/int8_ef in-flight ring chunks) composes with
+    zero1 AND steps_per_dispatch; M = 0 keeps the legacy composition
+    rules, where ``wire`` needs per-step gradient aggregation."""
     seq = seq or cfg.ctx_size
     n_dev = mesh.devices.size
     K = max(1, int(steps_per_dispatch))
+    M = int(overlap_microbatches)
     params = llama.init_llama(jax.random.key(0), cfg)
     opt = make_optimizer(opt_name)
 
     def loss_fn(p, batch):
         return llama.forward_loss(p, batch, cfg)
 
-    if wire is not None and (aggregation != "gradient" or K != 1):
+    if M == 0 and wire is not None and (aggregation != "gradient"
+                                        or K != 1):
         raise ValueError("wire compression composes with per-step gradient "
-                         "aggregation only")
-    if wire == "bf16":
+                         "aggregation only (pass overlap_microbatches >= 1 "
+                         "for the composing ring driver)")
+    if M >= 1:
+        from .parallel import compress
+        maker = (compress.make_overlap_multi_step if K > 1
+                 else compress.make_overlap_step)
+        state, step = maker(loss_fn, opt, mesh, params, microbatches=M,
+                            wire=wire or "fp32", aggregation=aggregation)
+    elif wire == "bf16":
         from .parallel import compress
         state = dp.replicate(mesh, dp.init_state(params, opt))
         step = compress.make_bf16_grad_step(loss_fn, opt, mesh)

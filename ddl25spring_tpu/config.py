@@ -160,6 +160,21 @@ class TrainConfig:
     # telemetry step events, checkpoint saves, StepGuard verdicts, preempt
     # checks) quantizes to chunk edges — see train/llm.py:_run_loop.
     steps_per_dispatch: int = 1
+    # Overlapped+compressed gradient sync (parallel/compress.py, DP
+    # trainer): M >= 1 routes gradient sync through the ACCO-style
+    # microbatch ring driver — each step's local batch splits into M
+    # microbatches and microbatch k+1's grad compute overlaps microbatch
+    # k's ppermute-pipelined ring reduce-scatter, with the in-flight
+    # chunks in the ``wire`` format (fp32 / bf16 / int8+error-feedback,
+    # EF residuals carried in the scan carry and the checkpointed state).
+    # Composes with aggregation in {"gradient", "zero1"} and
+    # steps_per_dispatch (bitwise-identical losses at any K for fixed M).
+    # M = 1 is the no-split ring (compressed wire at zero1 composition,
+    # no overlap); 0 disables — the legacy per-step paths run unchanged.
+    # Wire bytes scale with M on the ring leg (each microbatch syncs), so
+    # M > 1 trades wire for overlap — see docs/COMPONENTS.md's
+    # composition matrix.
+    overlap_microbatches: int = 0
     # In-jit numerics summaries (telemetry/introspect.py; DP trainer,
     # gradient/zero1): N > 0 instruments the compiled step with
     # per-layer-group grad/param/update norms + per-leaf NaN attribution

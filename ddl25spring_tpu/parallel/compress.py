@@ -46,7 +46,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..telemetry import comm
-from ._compat import shard_map
+from ._compat import axis_size, shard_map
 
 from .dp import TrainState, apply_optimizer, init_state, replicate
 
@@ -173,3 +173,360 @@ def make_int8_ef_grad_step(loss_fn: Callable,
         out_specs=(state_specs, P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------------
+# Overlapped, compressed gradient sync (the ACCO-style microbatch ring).
+#
+# The factories above compose with neither ``make_multi_step`` nor ZeRO-1 —
+# the fastest correctness path and the cheapest wire path were mutually
+# exclusive. The machinery below closes that: a ppermute-pipelined ring
+# reduce-scatter whose in-flight chunks can ride the wire in fp32, bf16 or
+# int8+error-feedback, driven by a microbatch software pipeline in which
+# microbatch k+1's gradient compute is dataflow-independent of microbatch
+# k's ring hops — the compute/comm overlap is explicit in the HLO, not
+# hoped-for from the XLA scheduler. Pattern references (PAPERS.md):
+# accumulate-while-you-communicate (ACCO, arxiv 2406.02613) and quantized
+# in-flight collectives (EQuARX, arxiv 2506.17615; DynamiQ, 2602.08923).
+
+
+def _int8_encode(c):
+    """Symmetric per-vector int8 quantization around max|c|: returns
+    ``(q, s, residual)`` with ``c ≈ s·q`` and ``residual = c − s·q`` (the
+    error-feedback remainder, |residual| ≤ s/2 elementwise)."""
+    s = jnp.maximum(jnp.max(jnp.abs(c)) / 127.0,
+                    jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(jnp.round(c / s), -127, 127).astype(jnp.int8)
+    return q, s, c - s * q.astype(jnp.float32)
+
+
+def ring_reduce_scatter(x, axis_name: str, *, wire: str = "fp32",
+                        residual=None, label: str = "ring_grad",
+                        comm_scale: int = 1):
+    """Pipelined ring reduce-scatter of a padded flat vector over
+    ``lax.ppermute`` hops, with a selectable wire format for the in-flight
+    chunk partials. Must run inside ``shard_map``.
+
+    ``x``: ``[n·chunk]`` fp32 local contribution (n = the axis size).
+    Returns ``(owned, residual')`` where ``owned`` is this shard's chunk of
+    the cross-shard SUM — chunk r lands on shard r, the ``lax.psum_scatter``
+    ownership convention — and ``residual'`` threads the int8
+    error-feedback state (flat ``[n·chunk]``, slot c = this shard's error
+    for chunk c's partial; pass ``None`` for fp32/bf16, where it is
+    returned unchanged).
+
+    Summation order (the documented ring spec, pinned bitwise against a
+    host-side reference in tests/test_compress.py): the partial for chunk c
+    starts at rank (c+1) % n and travels c+1 → c+2 → ... → c, each rank
+    adding its own contribution on receipt, so chunk c associates as
+    (((g_{c+1} + g_{c+2}) + ...) + g_c) with the OWNER's contribution added
+    last — in fp32, never quantized. XLA CPU's ``psum_scatter`` associates
+    rank-linearly (((g_0 + g_1) + g_2) + ...) instead, so the two are
+    bitwise-equal exactly when the addition is exact (pinned on
+    integer-valued gradients) and re-association-close otherwise; a ring
+    cannot reproduce the rank-linear order for every chunk without
+    serializing all partials through rank 0, which would forfeit the
+    balanced (n−1)·chunk_bytes wire profile this exists for.
+
+    Wire formats, applied to each hop's in-flight partial:
+    - ``"fp32"``: sent as-is — exact math at allreduce-parity wire.
+    - ``"bf16"``: cast to bf16 on the wire (half the bytes), upcast and
+      accumulated in fp32 on receipt; stateless — each hop's rounding is
+      dropped, like the bf16 pmean path above.
+    - ``"int8_ef"``: quantized to int8 around a per-hop scale that rides
+      alongside as one fp32 scalar per chunk per hop; the SENDER's
+      quantization error is fed back into its next send of the same chunk
+      slot (the residual — per (shard, chunk), so the static ring schedule
+      makes the feedback loop consistent across calls), restoring
+      convergence for the biased compressor exactly as error feedback does
+      for the all-gather path above.
+
+    Telemetry: every hop is a ``comm.ppermute`` record — (n−1) trips of
+    chunk-payload bytes per call (plus (n−1) 4-byte scale trips for int8),
+    so the comm profile's ring accounting reproduces the analytic
+    (n−1)·chunk_bytes wire formula exactly (pinned in
+    tests/test_telemetry.py).
+    """
+    if residual is not None and wire != "int8_ef":
+        # Fail loudly: the fp32/bf16 hops never touch the residual, and
+        # threading one through them would silently return garbage in
+        # place of accumulated EF state (the write-back below only covers
+        # the int8 schedule).
+        raise ValueError(f"residual is int8_ef-only (got wire={wire!r})")
+    n = axis_size(axis_name)
+    if n == 1:
+        return x, residual
+    chunk = x.shape[0] // n
+    chunks = x.reshape(n, chunk)
+    r = lax.axis_index(axis_name)
+    # Rank-relative schedule: rolled[t] = chunks[(r − 1 − t) % n] is the
+    # chunk this rank initiates/forwards at hop t, rolled[n−1] its own
+    # (received-last) chunk. The index map is an involution, so the same
+    # gather restores the residual's chunk-indexed layout on write-back.
+    idx = (r - 1 - jnp.arange(n)) % n
+    rolled = chunks[idx]
+    res_rolled = (residual.reshape(n, chunk)[idx]
+                  if residual is not None else None)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    new_res = []
+    partial = rolled[0]
+    for t in range(n - 1):
+        if wire == "int8_ef":
+            c = partial + res_rolled[t]
+            q, s, err = _int8_encode(c)
+            new_res.append(err)
+            q = comm.ppermute(q, axis_name, perm, label=f"{label}_int8",
+                              scale=comm_scale)
+            s = comm.ppermute(s, axis_name, perm, label=f"{label}_scale",
+                              scale=comm_scale)
+            got = s * q.astype(jnp.float32)
+        elif wire == "bf16":
+            got = comm.ppermute(partial.astype(jnp.bfloat16), axis_name,
+                                perm, label=f"{label}_bf16",
+                                scale=comm_scale).astype(jnp.float32)
+        elif wire == "fp32":
+            got = comm.ppermute(partial, axis_name, perm,
+                                label=f"{label}_f32", scale=comm_scale)
+        else:
+            raise ValueError(f"unknown ring wire format {wire!r}")
+        partial = got + rolled[t + 1]
+    if residual is not None:
+        # Own-chunk slot (never quantized by this rank) passes through.
+        new_res.append(res_rolled[n - 1])
+        # Involution: the same gather restores chunk-indexed flat layout.
+        residual = jnp.stack(new_res)[idx].reshape(-1)
+    return partial, residual
+
+
+class OverlapEFState(NamedTuple):
+    """TrainState + the two error-feedback residual trees of the int8 ring
+    driver, both sharded over ``data`` and zero at init:
+
+    - ``ring_residual`` [n, Ppad] (per-shard slice [1, Ppad]): chunk-indexed
+      per-hop quantization error of the gradient ring — shard r's slot c is
+      the error of the partial r last sent for chunk c (r's own chunk slot
+      stays 0: the owner's contribution is added in fp32).
+    - ``gather_residual`` [Ppad] (per-shard slice [local]): error of the
+      second-leg quantization — the param-delta broadcast (zero1) or the
+      reduced-grad-slice broadcast (gradient aggregation).
+
+    Both ride the scan carry of the K-step driver and the checkpointed
+    state tree, so the accumulated quantization error survives
+    ``make_overlap_multi_step`` composition, chunk-edge checkpoints and a
+    preempt/resume cycle exactly (pinned in tests/test_compress.py)."""
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+    ring_residual: Any
+    gather_residual: Any
+
+
+def _overlap_setup(mesh: Mesh, params, optimizer, wire: str,
+                   aggregation: str):
+    """State + shard specs + flat geometry for the overlap driver. The
+    zero1 variant reuses ``dp._zero1_setup`` wholesale, so the slice the
+    ring chunk lands on IS the slice the sharded update owns."""
+    from .dp import _flat_geometry, _zero1_setup
+
+    if aggregation not in ("gradient", "zero1"):
+        raise ValueError("overlap driver supports gradient/zero1 "
+                         f"aggregation only (got {aggregation!r})")
+    if wire not in ("fp32", "bf16", "int8_ef"):
+        raise ValueError(f"unknown wire format {wire!r}")
+    n, pad, local, total = _flat_geometry(mesh, params)
+    if aggregation == "zero1":
+        base, opt_specs, *_ = _zero1_setup(optimizer, mesh, params)
+    else:
+        base = replicate(mesh, init_state(params, optimizer))
+        opt_specs = P()
+    if wire == "int8_ef":
+        ppad = n * local
+        ring_res = jax.device_put(jnp.zeros((n, ppad), jnp.float32),
+                                  NamedSharding(mesh, P("data")))
+        gather_res = jax.device_put(jnp.zeros((ppad,), jnp.float32),
+                                    NamedSharding(mesh, P("data")))
+        state = OverlapEFState(base.params, base.opt_state, base.step,
+                               ring_res, gather_res)
+        specs = OverlapEFState(P(), opt_specs, P(), P("data"), P("data"))
+    else:
+        state = base
+        specs = TrainState(P(), opt_specs, P())
+    return state, specs, n, pad, local, total
+
+
+def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
+                             local: int, total: int, *, microbatches: int,
+                             wire: str, aggregation: str,
+                             comm_scale: int = 1) -> Callable:
+    """The per-shard overlapped step body shared by ``make_overlap_step``
+    and ``make_overlap_multi_step`` — one implementation, so per-step and
+    K-scanned dispatch cannot drift (their bitwise equality at any K is the
+    same contract ``make_multi_step`` pins).
+
+    Structure per step: the local batch splits into M microbatches; the
+    ring reduce-scatter of microbatch m−1's flat gradient is issued in the
+    same trace position as microbatch m's forward+backward, with no data
+    dependence between them — the explicit overlap. Reduced chunks
+    accumulate in fp32 on the owner; the result is averaged over n·M and
+    fed to the ZeRO-1 sliced update + (compressed) param gather, or
+    all-gathered (in the wire format) for the replicated update.
+
+    Numerics contract: microbatch gradients are REDUCED per microbatch and
+    summed on the owner (reduce-then-accumulate), whereas ``accum_steps``
+    accumulates locally then reduces once — same math, different float
+    association, so M>1 matches the monolithic paths to fp32 tolerance,
+    not bitwise (M=1 differs from them only by the ring-vs-linear
+    reduction order; see ``ring_reduce_scatter``). The int8 gather leg
+    broadcasts one quantized payload that every shard applies identically,
+    so replicas stay bitwise in sync in every mode."""
+    M = microbatches
+
+    def local_step(state, batch):
+        from ..utils import pytree as pt
+
+        if batch.shape[0] % M:
+            raise ValueError(f"local batch {batch.shape[0]} not divisible "
+                             f"by overlap_microbatches={M}")
+        params = state.params
+        ring_res = (state.ring_residual[0] if wire == "int8_ef" else None)
+        micro = batch.reshape((M, -1) + batch.shape[1:])
+        acc = jnp.zeros((local,), jnp.float32)
+        loss_sum = jnp.zeros((), jnp.float32)
+        pending = None
+        for m in range(M):
+            l, g = jax.value_and_grad(loss_fn)(params, micro[m])
+            loss_sum = loss_sum + l.astype(jnp.float32)
+            if pending is not None:
+                # Microbatch m−1's ring rides alongside microbatch m's
+                # grad compute (the lines above): independent dataflow.
+                red, ring_res = ring_reduce_scatter(
+                    pending, "data", wire=wire, residual=ring_res,
+                    comm_scale=comm_scale)
+                acc = acc + red
+            pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
+                              (0, pad))
+        red, ring_res = ring_reduce_scatter(
+            pending, "data", wire=wire, residual=ring_res,
+            comm_scale=comm_scale)
+        acc = acc + red
+        g_mine = acc / (n * M)      # mean over shards and microbatches
+        loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
+                          scale=comm_scale)
+
+        raw_flat, unravel = pt.flatten(params)
+        flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+        gather_res = None
+        if aggregation == "zero1":
+            shard = lax.axis_index("data")
+            p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
+            new_p_mine, opt_state = apply_optimizer(
+                optimizer, g_mine, state.opt_state, p_mine)
+            if wire == "int8_ef":
+                # Compressed second leg: broadcast the param DELTA int8
+                # (one byte/element + one scale/shard) with its own EF
+                # residual at the owner. Every shard — the owner included —
+                # applies the same dequantized deltas, so replicas stay
+                # bitwise identical; the fp32 moments stay exact; the
+                # quantization drift is compensated next step.
+                q, s, gather_res = _int8_encode(
+                    (new_p_mine - p_mine) + state.gather_residual)
+                q_all = comm.all_gather(q, "data", tiled=True,
+                                        label="overlap_delta_gather_int8",
+                                        scale=comm_scale)
+                s_all = comm.all_gather(s[None], "data", tiled=True,
+                                        label="overlap_delta_scale_gather",
+                                        scale=comm_scale)
+                flat_new = flat_p + (jnp.repeat(s_all, local)
+                                     * q_all.astype(jnp.float32))
+            else:
+                flat_new = comm.all_gather(new_p_mine, "data", tiled=True,
+                                           label="overlap_param_gather",
+                                           scale=comm_scale)
+            new_params = unravel(flat_new[:total].astype(raw_flat.dtype))
+        else:                       # replicated update
+            if wire == "int8_ef":
+                q, s, gather_res = _int8_encode(
+                    g_mine + state.gather_residual)
+                q_all = comm.all_gather(q, "data", tiled=True,
+                                        label="overlap_grad_gather_int8",
+                                        scale=comm_scale)
+                s_all = comm.all_gather(s[None], "data", tiled=True,
+                                        label="overlap_grad_scale_gather",
+                                        scale=comm_scale)
+                flat_g = (jnp.repeat(s_all, local)
+                          * q_all.astype(jnp.float32))
+            elif wire == "bf16":
+                flat_g = comm.all_gather(
+                    g_mine.astype(jnp.bfloat16), "data", tiled=True,
+                    label="overlap_grad_gather_bf16",
+                    scale=comm_scale).astype(jnp.float32)
+            else:
+                flat_g = comm.all_gather(g_mine, "data", tiled=True,
+                                         label="overlap_grad_gather",
+                                         scale=comm_scale)
+            grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            new_params, opt_state = apply_optimizer(
+                optimizer, grads, state.opt_state, params)
+        step = state.step + 1
+        if wire == "int8_ef":
+            new_state = OverlapEFState(new_params, opt_state, step,
+                                       ring_res[None], gather_res)
+        else:
+            new_state = TrainState(new_params, opt_state, step)
+        return new_state, loss
+
+    return local_step
+
+
+def make_overlap_step(loss_fn: Callable,
+                      optimizer: optax.GradientTransformation,
+                      mesh: Mesh, params, *, microbatches: int = 1,
+                      wire: str = "fp32",
+                      aggregation: str = "gradient"):
+    """Per-step overlapped+compressed gradient-sync driver: ``step(state,
+    batch) -> (state, loss)`` over a ``[B, T]`` batch sharded over
+    ``data``. Returns ``(state, step_fn)``; the state is an
+    ``OverlapEFState`` for ``wire="int8_ef"`` (EF residuals in the tree),
+    a plain TrainState otherwise — with ZeRO-1-sharded moments when
+    ``aggregation="zero1"``. Semantics in ``_make_overlap_local_step``."""
+    state, specs, n, pad, local, total = _overlap_setup(
+        mesh, params, optimizer, wire, aggregation)
+    local_step = _make_overlap_local_step(
+        loss_fn, optimizer, n, pad, local, total, microbatches=microbatches,
+        wire=wire, aggregation=aggregation)
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P("data")), out_specs=(specs, P()),
+        check_vma=False)
+    return state, jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_overlap_multi_step(loss_fn: Callable,
+                            optimizer: optax.GradientTransformation,
+                            mesh: Mesh, params, *, microbatches: int = 1,
+                            wire: str = "fp32",
+                            aggregation: str = "gradient"):
+    """The overlapped+compressed driver inside the K-step scan:
+    ``step(state, window) -> (state, losses)`` with ``window`` a
+    ``[K, n_shards·B, T]`` batch window (``dp.shard_batch_window``) run in
+    ONE compiled, donated dispatch. The scanned body IS
+    ``make_overlap_step``'s body, so the loss sequence and final state are
+    bitwise-identical to K per-step calls at any K and M (pinned in
+    tests/test_compress.py) — and the int8 EF residuals ride the scan
+    carry, so error feedback is exact across fused steps and chunk-edge
+    checkpoints."""
+    state, specs, n, pad, local, total = _overlap_setup(
+        mesh, params, optimizer, wire, aggregation)
+
+    def multi(state, window):
+        local_step = _make_overlap_local_step(
+            loss_fn, optimizer, n, pad, local, total,
+            microbatches=microbatches, wire=wire, aggregation=aggregation,
+            comm_scale=window.shape[0])
+        return lax.scan(local_step, state, window)
+
+    sharded = shard_map(
+        multi, mesh=mesh,
+        in_specs=(specs, P(None, "data")), out_specs=(specs, P()),
+        check_vma=False)
+    return state, jax.jit(sharded, donate_argnums=(0,))

@@ -273,6 +273,22 @@ def make_weight_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTra
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def _flat_geometry(mesh: Mesh, params):
+    """Padded flat-vector geometry shared by ZeRO-1 and the overlapped ring
+    driver (parallel/compress.py): ``(n, pad, local, total)`` — n = the
+    ``data`` axis size, total = the param count, pad brings it to a multiple
+    of n, local = (total + pad) // n = one shard's slice (and one ring
+    chunk). One implementation so the slice a ring chunk lands on is always
+    the slice the ZeRO-1 update owns."""
+    from ..utils import pytree as pt
+
+    n = mesh.shape["data"]
+    total = pt.param_count(params)
+    pad = (-total) % n
+    local = (total + pad) // n
+    return n, pad, local, total
+
+
 def _zero1_setup(optimizer, mesh: Mesh, params):
     """Shared ZeRO-1 initialization: the padded flat-vector geometry, the
     local-slice optimizer PartitionSpecs, and the initial TrainState with
@@ -282,10 +298,7 @@ def _zero1_setup(optimizer, mesh: Mesh, params):
     slice"). Returns ``(state, opt_specs, n, pad, local, total)``."""
     from ..utils import pytree as pt
 
-    n = mesh.shape["data"]
-    total = pt.param_count(params)
-    pad = (-total) % n
-    local = (total + pad) // n
+    n, pad, local, total = _flat_geometry(mesh, params)
 
     # PartitionSpecs for the local-slice optimizer state: vector leaves
     # (mu/nu, [local]) shard over ``data``; scalars (count) replicate —

@@ -125,7 +125,8 @@ class CommProfile:
             agg["wire_bytes_per_device"] += r.wire_bytes_per_device * r.scale
         return out
 
-    def as_dict(self, *, steps_per_dispatch: int = 1) -> dict:
+    def as_dict(self, *, steps_per_dispatch: int = 1,
+                overlap_microbatches: int = 1) -> dict:
         """JSON-able shape for the run manifest / bench telemetry block.
 
         The profile's aggregates cover one traced CALL. For a fused
@@ -134,6 +135,17 @@ class CommProfile:
         dict carries the per-TRAIN-STEP normalization alongside the
         per-dispatch totals, so "wire bytes per step" stays comparable
         across K (the no-regression check the zero1/scan work is held to).
+
+        Normalization rule (pinned in tests/test_telemetry.py so future
+        drivers can't double-count): the per-train-step figures divide the
+        per-dispatch totals by ``steps_per_dispatch`` ONLY. The overlap
+        driver's M microbatch rings (parallel/compress.py) are all part of
+        ONE step's traffic — its unrolled ring hops each record their own
+        ppermute at ``scale=K``, so dividing by K already yields the exact
+        per-step bytes, and dividing by M as well would under-count a
+        step's wire M×. ``overlap_microbatches`` = M > 1 instead ADDS the
+        per-microbatch-ring view (per-train-step ÷ M) alongside, for
+        readers sizing one ring trip.
         """
         d = {
             "payload_bytes_per_step": self.payload_bytes_per_step,
@@ -147,6 +159,12 @@ class CommProfile:
                 self.payload_bytes_per_step / steps_per_dispatch
             d["wire_bytes_per_device_per_train_step"] = \
                 self.wire_bytes_per_device_per_step / steps_per_dispatch
+        if overlap_microbatches > 1:
+            d["overlap_microbatches"] = int(overlap_microbatches)
+            per_step = (self.wire_bytes_per_device_per_step
+                        / steps_per_dispatch)
+            d["wire_bytes_per_device_per_microbatch"] = \
+                per_step / overlap_microbatches
         return d
 
 

@@ -151,7 +151,8 @@ def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
 
 def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
                    mesh, start_step: int, step_fn, state, n_data: int,
-                   steps_per_dispatch: int = 1, windowed: bool = False) -> None:
+                   steps_per_dispatch: int = 1, windowed: bool = False,
+                   overlap_microbatches: int = 1) -> None:
     """Open a telemetry run: one manifest event carrying the configuration
     and the step's static communication profile (telemetry/comm.py —
     measured by abstract tracing BEFORE the first real call, so the trace
@@ -174,8 +175,10 @@ def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
             batch_shape = (steps_per_dispatch,) + batch_shape
         batch_sds = jax.ShapeDtypeStruct(batch_shape, jnp.int32)
         profile = measure_comm(step_fn, state, batch_sds)
-        comm_profile = (profile.as_dict(steps_per_dispatch=steps_per_dispatch)
-                        if profile is not None else None)
+        comm_profile = (profile.as_dict(
+            steps_per_dispatch=steps_per_dispatch,
+            overlap_microbatches=overlap_microbatches)
+            if profile is not None else None)
     except Exception:
         pass                       # telemetry must never sink a trainer
     platform = jax.devices()[0].platform
@@ -930,6 +933,17 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     one compiled, donated dispatch over a [K, B, T] batch window, host work
     quantized to chunk edges — semantics spelled out in ``_run_loop``.
 
+    ``train_cfg.overlap_microbatches`` = M >= 1 routes gradient sync
+    through the overlapped ring driver (parallel/compress.py
+    ``make_overlap_step`` / ``make_overlap_multi_step``): the batch splits
+    into M microbatches whose grad computes overlap the previous
+    microbatch's ppermute-pipelined ring reduce-scatter, with in-flight
+    chunks in the ``wire`` format — the one path where wire compression
+    composes with zero1 AND steps_per_dispatch. int8 EF residuals live in
+    the state tree, so checkpoints/preemption carry them exactly. Replaces
+    ``accum_steps`` (same batch axis); numerics/elastic do not compose
+    yet.
+
     ``loss_sink(it, loss)`` fires every ``sink_every`` iterations with the
     host-synced loss — for incremental result recording that survives a
     killed run (each call forces a device sync; use only where the step
@@ -983,9 +997,16 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     spd = train_cfg.steps_per_dispatch
     if spd < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
+    ovl = train_cfg.overlap_microbatches
+    if ovl < 0:
+        raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
     elastic = bool(resilience is not None and resilience.elastic)
     numerics = None
     if train_cfg.numerics_every > 0:
+        if ovl:
+            raise ValueError("numerics_every does not compose with "
+                             "overlap_microbatches yet (the ring driver "
+                             "owns its collective schedule)")
         # In-jit run-health numerics (telemetry/introspect.py): supported
         # exactly where the shared step body lives — gradient/zero1 on the
         # fp32 wire, non-elastic (the compressed steps own their collective
@@ -1014,6 +1035,10 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                              f"aggregation only (got {aggregation!r})")
         if train_cfg.wire != "fp32":
             raise ValueError("elastic mode requires wire='fp32'")
+        if ovl:
+            raise ValueError("elastic mode does not compose with "
+                             "overlap_microbatches yet (nobody has taught "
+                             "the ring driver to re-mesh)")
         if any(s > 1 for a, s in mesh.shape.items() if a != "data"):
             raise ValueError("elastic mode supports data-axis-only meshes "
                              f"(got {dict(mesh.shape)})")
@@ -1044,7 +1069,29 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                                        int(w.shape[0])})
             return st, fn, (lambda w, m=m: dp.shard_batch_window(m, w))
     state = None
-    if train_cfg.wire != "fp32":
+    if ovl >= 1:
+        # Overlapped+compressed gradient sync (parallel/compress.py ring
+        # driver): the one path where wire ∈ {fp32, bf16, int8_ef}
+        # composes with aggregation ∈ {gradient, zero1} AND
+        # steps_per_dispatch. Microbatching replaces accum_steps (both
+        # split the same batch axis); hard errors, not asserts.
+        if aggregation not in ("gradient", "zero1"):
+            raise ValueError("overlap_microbatches supports gradient and "
+                             f"zero1 aggregation only (got {aggregation!r})")
+        if train_cfg.accum_steps != 1:
+            raise ValueError("overlap_microbatches replaces accum_steps "
+                             "(both split the local batch axis); set "
+                             "accum_steps=1")
+        from ..parallel import compress
+        if spd > 1:
+            state, step_fn = compress.make_overlap_multi_step(
+                loss_fn, optimizer, mesh, params, microbatches=ovl,
+                wire=train_cfg.wire, aggregation=aggregation)
+        else:
+            state, step_fn = compress.make_overlap_step(
+                loss_fn, optimizer, mesh, params, microbatches=ovl,
+                wire=train_cfg.wire, aggregation=aggregation)
+    elif train_cfg.wire != "fp32":
         # Compressed gradient allreduce (parallel/compress.py) — gradient
         # aggregation only, and accumulation stays at 1 (the compressed
         # steps own their collective schedule). Hard errors, not asserts:
@@ -1057,7 +1104,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                 "accumulation or multi-step dispatch (got "
                 f"aggregation={aggregation!r}, "
                 f"accum_steps={train_cfg.accum_steps}, "
-                f"steps_per_dispatch={spd})")
+                f"steps_per_dispatch={spd}) — overlap_microbatches >= 1 "
+                "is the composing path")
         from ..parallel import compress
         if train_cfg.wire == "bf16":
             step_fn = compress.make_bf16_grad_step(loss_fn, optimizer, mesh)
@@ -1117,7 +1165,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         # measure_comm/eval_shape — attribute access delegates.
         step_fn = introspect.watch(
             step_fn,
-            name=f"train/dp-{aggregation}" + (f"-k{spd}" if spd > 1 else ""),
+            name=f"train/dp-{aggregation}"
+                 + (f"-k{spd}" if spd > 1 else "")
+                 + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""),
             max_caches=(1 if spd == 1 else None),
             events=(telemetry.events if telemetry is not None else None),
             # Chunked mode stamps each compile event with the COMPILING
@@ -1139,7 +1189,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     _emit_manifest(telemetry, trainer="dp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
                    step_fn=step_fn, state=state, n_data=n_data,
-                   steps_per_dispatch=spd, windowed=elastic)
+                   steps_per_dispatch=spd, windowed=elastic,
+                   overlap_microbatches=max(1, ovl))
     if fault_plan is None and resilience is not None and resilience.faults:
         fault_plan = resilience.fault_plan()   # resolve ONCE: the elastic
         #   rebuild must re-wrap the same schedule, not a fresh counter's
@@ -1230,6 +1281,10 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     if train_cfg.wire != "fp32":
         raise ValueError("wire compression (TrainConfig.wire) is DP-trainer-"
                          "only; the pipeline step owns its own collectives")
+    if train_cfg.overlap_microbatches != 0:
+        raise ValueError("overlap_microbatches (the ring-overlap driver) is "
+                         "DP-trainer-only; the pipeline schedule already "
+                         "owns its microbatching")
     if train_cfg.steps_per_dispatch != 1:
         raise ValueError("steps_per_dispatch (fused multi-step dispatch) is "
                          "DP-trainer-only; the pipeline step owns its own "

@@ -10,6 +10,10 @@ nonzero when the newest comparable row regresses more than
 ``--max-regression`` percent against the best committed row of the SAME
 platform tag: CPU-fallback numbers must never be judged against a TPU
 row (the committed history mixes both — see ROADMAP "Perf trajectory").
+Rows are direction-aware: throughput-like metrics regress downward, while
+``wire_bytes_*`` / ``payload_bytes_*`` rows (the comm-wire smoke's) are
+lower-is-better and gate when the candidate RISES above the best (lowest)
+committed row — see ``lower_is_better``.
 
 ``--warn-only`` (how tier1.yml runs it, over the reduced bench smoke)
 prints the verdict but always exits 0: the QUICK-mode smoke is noisy by
@@ -39,6 +43,20 @@ from typing import Any, Dict, List, Optional, Tuple
 # one gets no derived entry rather than landing in a "None" bucket both
 # platforms would share.
 DERIVED_FIELDS = ("mfu", "attainment")
+
+# Direction map. Most headline rows are throughput-like (higher is
+# better), but the comm-wire smoke's byte rows regress UPWARD — more
+# bytes is worse — and judging them higher-is-better would wave a
+# wire-bytes regression through as an "improvement". A metric whose name
+# starts with one of these prefixes is compared against the best (LOWEST)
+# committed row and gates when the candidate rises above it by more than
+# the budget.
+LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes")
+
+
+def lower_is_better(metric: str) -> bool:
+    """True for metrics where a SMALLER value is the better one."""
+    return str(metric).startswith(LOWER_IS_BETTER_PREFIXES)
 
 
 def parse_rows(path: str) -> List[Dict[str, Any]]:
@@ -72,6 +90,13 @@ def parse_rows(path: str) -> List[Dict[str, Any]]:
     if isinstance(doc, dict):
         _add(doc)
         _add(doc.get("parsed"))
+        # Smoke artifacts (e.g. comm-wire.json) carry a "rows" list of
+        # row objects — the comm-wire smoke's wire-byte rows enter the
+        # trajectory through here.
+        rows_field = doc.get("rows")
+        if isinstance(rows_field, list):
+            for obj in rows_field:
+                _add(obj)
         text = doc.get("tail") or ""
     for line in text.splitlines():
         line = line.strip()
@@ -137,15 +162,21 @@ def compare(files: List[str], candidate: Optional[str],
         if judged is None and len(traj) >= 2:
             judged, baseline_pool = traj[-1], traj[:-1]
         if judged is not None and baseline_pool:
-            best_name, best = max(baseline_pool, key=lambda nv: nv[1])
+            lower = lower_is_better(metric)
+            best_name, best = (min if lower else max)(
+                baseline_pool, key=lambda nv: nv[1])
             name, value = judged
             delta_pct = 100 * (value - best) / best if best else 0.0
+            # "How much worse", direction-aware: for lower-is-better rows
+            # a POSITIVE delta (more bytes) is the regression.
+            worse_pct = delta_pct if lower else -delta_pct
             verdict = "ok"
-            if delta_pct < -max_regression_pct:
+            if worse_pct > max_regression_pct:
                 verdict = "REGRESSION"
                 regressions.append(
                     f"{metric} [{platform} / {variant}]: {name} = "
-                    f"{value:,.1f} is {-delta_pct:.1f}% below best "
+                    f"{value:,.1f} is {worse_pct:.1f}% "
+                    f"{'above' if lower else 'below'} best "
                     f"committed {best:,.1f} ({best_name}) — budget "
                     f"{max_regression_pct:.0f}%")
             lines.append(f"  {name:24s} {_fmt_val(value)}  "

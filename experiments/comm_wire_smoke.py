@@ -1,0 +1,163 @@
+"""Comm-wire smoke: the overlapped+compressed sync's wire claim, checked.
+
+The CI-sized proof (tier1.yml) that the ring driver's headline holds on
+the CPU mesh with zero hand-waving: build the reduced bench's
+``int8_ef + zero1 + scan4`` composition (parallel/compress.py
+``make_overlap_multi_step``) next to the f32-allreduce baseline on the
+SAME model/mesh in the SAME run, read both static comm profiles
+(telemetry/comm.py — exact, trace-time), and CHECK:
+
+1. per-train-step wire bytes of the compressed composition ≤ ~¼ of the
+   f32 allreduce row (the ≥4× drop at ZeRO-1 memory parity; the small
+   slack covers the per-hop fp32 scale scalars and the loss allreduce);
+2. the ring accounting is EXACT: the profile's ppermute trips × chunk
+   payloads equal the analytic K·M·(n−1)·chunk_bytes wire formula to the
+   byte, for both the int8 payload hops and their scale sidecars;
+3. zero retraces across the mode grid (wire × microbatches at zero1 ×
+   scan4): each composition compiles exactly once over repeated
+   same-shape dispatches, pinned through introspect.CompileWatch.
+
+Wire-byte rows land in the JSON artifact in the bench_compare row shape
+({"metric": "wire_bytes_per_train_step", ...}) — lower-is-better rows the
+comparator now gates in the right direction. Diagnostics live IN the
+JSON (the tier1 don't-clobber contract); exit 0 only when every check
+holds.
+
+    python -m experiments.comm_wire_smoke --out comm-wire.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run(out_path: str) -> int:
+    from ._cpu_pin import pin_cpu_virtual
+    pin_cpu_virtual()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import compress, dp, make_mesh
+    from ddl25spring_tpu.telemetry import introspect, measure_comm
+
+    n, K = 4, 4
+    mesh = make_mesh({"data": n}, devices=jax.devices()[:n])
+    cfg = LlamaConfig(vocab_size=259, dmodel=32, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    opt = lambda: optax.adam(1e-3)  # noqa: E731
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    def fresh_params():
+        return llama.init_llama(jax.random.key(0), cfg)
+
+    bsz = 2                                   # per shard
+    batch_sds = jax.ShapeDtypeStruct((n * bsz, cfg.ctx_size), jnp.int32)
+    window_sds = jax.ShapeDtypeStruct((K, n * bsz, cfg.ctx_size),
+                                      jnp.int32)
+
+    checks, rows, profiles = {}, [], {}
+
+    # ---- baseline: the f32 gradient allreduce (per-step, plain DP) ----
+    base_state = dp.replicate(mesh, dp.init_state(fresh_params(), opt()))
+    base_step = dp.make_grad_aggregation_step(loss_fn, opt(), mesh)
+    base_prof = measure_comm(base_step, base_state, batch_sds)
+    base_wire = base_prof.wire_bytes_per_device_per_step
+    profiles["f32_allreduce"] = base_prof.as_dict()
+    rows.append({"metric": "wire_bytes_per_train_step",
+                 "value": base_wire, "unit": "bytes/device/step",
+                 "platform": "cpu", "variant": "f32-allreduce"})
+
+    # ---- candidate: int8_ef + zero1 + scan4 through the ring driver ----
+    cand_state, cand_step = compress.make_overlap_multi_step(
+        loss_fn, opt(), mesh, fresh_params(), microbatches=1,
+        wire="int8_ef", aggregation="zero1")
+    cand_prof = measure_comm(cand_step, cand_state, window_sds)
+    cand_wire = cand_prof.wire_bytes_per_device_per_step / K
+    profiles["int8ef_zero1_scan4"] = cand_prof.as_dict(
+        steps_per_dispatch=K)
+    rows.append({"metric": "wire_bytes_per_train_step",
+                 "value": cand_wire, "unit": "bytes/device/step",
+                 "platform": "cpu", "variant": "int8ef+zero1+scan4"})
+
+    ratio = cand_wire / base_wire
+    checks["wire_ratio"] = {"value": ratio, "budget": 0.26,
+                            "ok": ratio <= 0.26,
+                            "f32_allreduce_bytes": base_wire,
+                            "int8_ring_bytes": cand_wire}
+
+    # ---- exact ring accounting vs the analytic formula ----
+    from ddl25spring_tpu.parallel.dp import _flat_geometry
+    _, _, local, _ = _flat_geometry(mesh, fresh_params())
+    by = cand_prof.by_label()
+    got_payload = by["ring_grad_int8"]["payload_bytes"]
+    want_payload = K * 1 * (n - 1) * local * 1        # K·M·(n−1)·chunk int8
+    got_scales = by["ring_grad_scale"]["payload_bytes"]
+    want_scales = K * 1 * (n - 1) * 4                  # one fp32 per hop
+    got_wire = by["ring_grad_int8"]["wire_bytes_per_device"]
+    checks["ring_analytic"] = {
+        "payload": {"got": got_payload, "want": want_payload},
+        "scales": {"got": got_scales, "want": want_scales},
+        # ppermute ring factor is 1 per trip: wire == payload, exactly.
+        "wire_eq_payload": got_wire == got_payload,
+        "ok": (got_payload == want_payload and got_scales == want_scales
+               and got_wire == got_payload)}
+
+    # ---- zero retraces across the mode grid (and real execution) ----
+    rng = np.random.default_rng(0)
+    window = rng.integers(0, cfg.vocab_size,
+                          size=(K, n * bsz, cfg.ctx_size)).astype(np.int32)
+    retraces = {}
+    for wire in ("fp32", "bf16", "int8_ef"):
+        for m in (1, 2):
+            state, step = compress.make_overlap_multi_step(
+                loss_fn, opt(), mesh, fresh_params(), microbatches=m,
+                wire=wire, aggregation="zero1")
+            step = introspect.watch(step, name=f"smoke/{wire}-m{m}",
+                                    max_caches=1)
+            loss = None
+            for _ in range(3):
+                state, losses = step(state,
+                                     dp.shard_batch_window(mesh, window))
+                loss = float(np.asarray(losses)[-1])
+            retraces[f"{wire}-m{m}"] = {
+                "compiles": len(step.compiles),
+                "retraces": sum(1 for c in step.compiles if c.retrace),
+                "final_loss": loss,
+                "ok": bool(len(step.compiles) == 1
+                           and not any(c.retrace for c in step.compiles)
+                           and np.isfinite(loss))}
+    checks["retraces"] = {"grid": retraces,
+                          "ok": all(v["ok"] for v in retraces.values())}
+
+    ok = all(c["ok"] for c in checks.values())
+    doc = {"ok": ok, "n_devices": n, "steps_per_dispatch": K,
+           "model": {"dmodel": cfg.dmodel, "n_layers": cfg.n_layers,
+                     "vocab": cfg.vocab_size, "ctx": cfg.ctx_size},
+           "checks": checks, "rows": rows, "profiles": profiles}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"comm-wire smoke: ratio {ratio:.3f} (budget 0.26), "
+          f"ring accounting {'exact' if checks['ring_analytic']['ok'] else 'WRONG'}, "
+          f"retraces {'clean' if checks['retraces']['ok'] else 'DIRTY'} "
+          f"-> {out_path}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="comm-wire.json")
+    a = ap.parse_args(argv)
+    return run(a.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

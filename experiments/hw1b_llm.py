@@ -38,19 +38,23 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                 fault_seed: int = 0, guard: bool = False,
                 telemetry_dir: str = None, steps_per_dispatch: int = 1,
                 zero1: bool = False, elastic: bool = False,
-                numerics_every: int = 0) -> Dict[str, float]:
+                numerics_every: int = 0, wire: str = "fp32",
+                overlap_microbatches: int = 0) -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
     if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1 or elastic
-                              or numerics_every):
+                              or numerics_every or wire != "fp32"
+                              or overlap_microbatches):
         # These levers are DP-trainer-only (the PP step owns its
         # own schedule/collectives); failing loudly beats silently timing
         # the wrong program.
         raise ValueError(f"--steps-per-dispatch/--zero1/--elastic/"
-                         f"--numerics-every need a DP config (got {name})")
+                         f"--numerics-every/--wire/--overlap-microbatches "
+                         f"need a DP config (got {name})")
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
-                            numerics_every=numerics_every,
+                            numerics_every=numerics_every, wire=wire,
+                            overlap_microbatches=overlap_microbatches,
                             **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
     label = f"{name}_b{train_cfg.data * train_cfg.batch_size}_seq256_adam8e-4"
@@ -58,6 +62,10 @@ def _run_config(name: str, iters: int, sink, provenance: str,
         label += f"_k{steps_per_dispatch}"
     if zero1:
         label += "_zero1"
+    if wire != "fp32":
+        label += f"_{wire}"
+    if overlap_microbatches:
+        label += f"_ring_m{overlap_microbatches}"
     log_every = max(1, min(iters // 10, 25))
     kw = {}
     if checkpoint_dir is not None:
@@ -141,7 +149,8 @@ def main(quick: bool = False, iters: int = 5000,
          fault_seed: int = 0, guard: bool = False,
          telemetry_dir: str = None, steps_per_dispatch: int = 1,
          zero1: bool = False, elastic: bool = False,
-         numerics_every: int = 0) -> Dict[str, float]:
+         numerics_every: int = 0, wire: str = "fp32",
+         overlap_microbatches: int = 0) -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -171,7 +180,8 @@ def main(quick: bool = False, iters: int = 5000,
                                telemetry_dir=telemetry_dir,
                                steps_per_dispatch=steps_per_dispatch,
                                zero1=zero1, elastic=elastic,
-                               numerics_every=numerics_every))
+                               numerics_every=numerics_every, wire=wire,
+                               overlap_microbatches=overlap_microbatches))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -226,6 +236,20 @@ if __name__ == "__main__":
                          "grad/param/update-norm event every N steps; "
                          "0 disables (DP configs only; bitwise-free — "
                          "losses identical on vs off)")
+    ap.add_argument("--wire", default="fp32",
+                    choices=["fp32", "bf16", "int8_ef"],
+                    help="gradient-sync wire format (parallel/compress.py); "
+                         "composes with --zero1/--steps-per-dispatch only "
+                         "through --overlap-microbatches >= 1 (the ring "
+                         "driver)")
+    ap.add_argument("--overlap-microbatches", type=int, default=0,
+                    help="ACCO-style overlapped ring driver (parallel/"
+                         "compress.py): split each step into M microbatches "
+                         "and overlap microbatch k+1's grad compute with "
+                         "microbatch k's ppermute ring reduce-scatter, "
+                         "in-flight chunks in --wire's format; 1 = "
+                         "no-split compressed ring, 0 = legacy paths; "
+                         "DP configs only")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic DP (resilience/elastic.py): survive "
                          "replica loss (inject with --faults "
@@ -246,4 +270,5 @@ if __name__ == "__main__":
          fault_seed=a.fault_seed, guard=a.guard,
          telemetry_dir=a.telemetry_dir,
          steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1,
-         elastic=a.elastic, numerics_every=a.numerics_every)
+         elastic=a.elastic, numerics_every=a.numerics_every, wire=a.wire,
+         overlap_microbatches=a.overlap_microbatches)

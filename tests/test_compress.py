@@ -159,3 +159,353 @@ def test_llm_end_to_end(maker):
         state, loss = step(state, sb)
         first = first if first is not None else float(loss)
     assert float(loss) < first
+
+
+# ---------------------------------------------------------------------------
+# Overlapped, compressed gradient sync (the ACCO-style microbatch ring).
+#
+# Pins: (1) the ppermute ring reduce-scatter is bitwise-equal to its
+# documented ring-order spec and to lax.psum_scatter wherever the addition
+# is exact (the two associate differently, so general floats match to
+# re-association tolerance); (2) wire dtypes really ride the ppermute hops
+# (jaxpr evidence); (3) the K-step scanned driver is bitwise the per-step
+# driver at any K and M, for every wire format; (4) M=1 f32 matches the
+# existing fused paths to fp32 tolerance; (5) int8+EF converges where the
+# ring quantization alone would stall, and the EF residuals survive a
+# preempt/resume cycle EXACTLY (bitwise trajectory across the restart) —
+# on the new driver and on the legacy per-step int8 path.
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddl25spring_tpu.parallel._compat import shard_map
+
+
+def _mesh4(devices):
+    return make_mesh({"data": 4}, devices=devices[:4])
+
+
+def _ring_spec_reference(cols, owner, n):
+    """Host-side spec of the ring order: chunk ``owner``'s partial starts
+    at rank owner+1 and accumulates one rank per hop, the owner last."""
+    c = cols[0].shape[0] // n
+    sl = slice(owner * c, (owner + 1) * c)
+    order = [(owner + 1 + i) % n for i in range(n)]
+    s = cols[order[0]][sl].copy()
+    for i in order[1:]:
+        s = s + cols[i][sl]
+    return s
+
+
+def test_ring_reduce_scatter_matches_spec_order_bitwise(devices):
+    """The f32 ring is bitwise its documented summation order — chunk c
+    associates as (((g_{c+1} + g_{c+2}) + ...) + g_c) — on every shard."""
+    n = 4
+    mesh = _mesh4(devices)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n * 6)).astype(np.float32)
+
+    def f(v):
+        out, _ = compress.ring_reduce_scatter(v, "data", wire="fp32")
+        return out
+
+    g = jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data"), check_vma=False))
+    out = np.asarray(g(jax.device_put(
+        x.reshape(-1), NamedSharding(mesh, P("data"))))).reshape(n, 6)
+    for r in range(n):
+        np.testing.assert_array_equal(
+            out[r], _ring_spec_reference(list(x), r, n))
+
+
+def test_ring_reduce_scatter_vs_psum_scatter(devices):
+    """Satellite pin: vs ``lax.psum_scatter``. XLA CPU's scatter associates
+    rank-linearly while the ring associates ring-order (a ring cannot
+    produce the linear order for every chunk without serializing through
+    rank 0), so the contract is: BITWISE equality wherever the addition is
+    exact — integer-valued gradients, where association cannot matter —
+    and re-association tolerance on general floats."""
+    from jax import lax
+    n = 4
+    mesh = _mesh4(devices)
+    rng = np.random.default_rng(1)
+
+    def f_ring(v):
+        out, _ = compress.ring_reduce_scatter(v, "data", wire="fp32")
+        return out
+
+    def f_ref(v):
+        return lax.psum_scatter(v, "data", scatter_dimension=0, tiled=True)
+
+    ring = jax.jit(shard_map(f_ring, mesh=mesh, in_specs=P("data"),
+                             out_specs=P("data"), check_vma=False))
+    ref = jax.jit(shard_map(f_ref, mesh=mesh, in_specs=P("data"),
+                            out_specs=P("data"), check_vma=False))
+
+    exact = jax.device_put(
+        rng.integers(-1000, 1000, size=n * n * 8).astype(np.float32),
+        NamedSharding(mesh, P("data")))
+    np.testing.assert_array_equal(np.asarray(ring(exact)),
+                                  np.asarray(ref(exact)))
+    floats = jax.device_put(
+        rng.standard_normal(n * n * 8).astype(np.float32),
+        NamedSharding(mesh, P("data")))
+    np.testing.assert_allclose(np.asarray(ring(floats)),
+                               np.asarray(ref(floats)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_overlap_wire_dtypes_ride_the_ppermute_hops():
+    """jaxpr evidence that the ring's in-flight chunks are COMPRESSED: the
+    int8 driver's ppermutes carry i8 chunk payloads (plus f32 scalar
+    scales) and no gradient-sized f32 ppermute exists; the bf16 driver's
+    carry bf16."""
+    mesh = _mesh2()
+    params, loss_fn, batch, _ = _quadratic_setup(jax.random.key(1))
+    opt = optax.sgd(0.05)
+
+    state8, step8 = compress.make_overlap_step(
+        loss_fn, opt, mesh, params, microbatches=2, wire="int8_ef",
+        aggregation="zero1")
+    jx8 = str(jax.make_jaxpr(lambda s, b: step8(s, b))(
+        state8, dp.shard_batch(mesh, batch)))
+    hops = [ln for ln in jx8.splitlines() if "ppermute" in ln]
+    assert any(":i8[32]" in ln or "i8[32]" in ln for ln in hops), \
+        f"no int8 chunk hop in: {hops}"
+    for ln in hops:
+        # f32 ppermutes may carry only the scalar scale sidecars (f32[]).
+        assert "f32[32]" not in ln, \
+            f"gradient-sized f32 hop on the wire: {ln}"
+
+    stateb, stepb = compress.make_overlap_step(
+        loss_fn, opt, mesh, params, microbatches=1, wire="bf16",
+        aggregation="gradient")
+    jxb = str(jax.make_jaxpr(lambda s, b: stepb(s, b))(
+        stateb, dp.shard_batch(mesh, batch))).replace("bfloat16", "bf16")
+    hops = [ln for ln in jxb.splitlines() if "ppermute" in ln]
+    assert any("bf16[32]" in ln for ln in hops), \
+        f"no bf16 chunk hop in: {hops}"
+
+
+@pytest.mark.parametrize("wire", ["fp32", "bf16", "int8_ef"])
+def test_overlap_multi_step_bitwise_matches_per_step(devices, wire):
+    """The fused K-step overlap driver reproduces the per-step driver's
+    loss sequence AND final state bitwise at K=4, M=2 — the scanned body
+    is the shared local step, so drift is a bug (the make_multi_step
+    contract carried to the ring driver; for int8 this additionally
+    proves the EF residuals thread the scan carry exactly)."""
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+
+    mesh = _mesh4(devices)
+    cfg = LlamaConfig(vocab_size=64, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=8)
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    ks = jax.random.split(jax.random.key(2), 4)
+    batches = [jax.random.randint(k, (8, 8), 0, 64) for k in ks]
+
+    s1, step1 = compress.make_overlap_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg),
+        microbatches=2, wire=wire, aggregation="zero1")
+    ref = []
+    for b in batches:
+        s1, l = step1(s1, dp.shard_batch(mesh, b))
+        ref.append(float(l))
+
+    sK, stepK = compress.make_overlap_multi_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg),
+        microbatches=2, wire=wire, aggregation="zero1")
+    sK, losses = stepK(sK, dp.shard_batch_window(mesh, np.stack(batches)))
+    assert [float(x) for x in np.asarray(losses)] == ref
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(sK)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_f32_matches_existing_paths(devices):
+    """M=1 f32 ring vs the existing fused paths: same math, ring-vs-linear
+    reduction order only — fp32-tolerance equality for both aggregations
+    (the overlap restructuring itself must not touch the numerics)."""
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+
+    mesh = _mesh4(devices)
+    cfg = LlamaConfig(vocab_size=64, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=8)
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    batches = [jax.random.randint(k, (8, 8), 0, 64)
+               for k in jax.random.split(jax.random.key(3), 3)]
+
+    z_state, z_step = dp.make_zero1_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg))
+    o_state, o_step = compress.make_overlap_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg),
+        microbatches=1, wire="fp32", aggregation="zero1")
+    for b in batches:
+        z_state, zl = z_step(z_state, dp.shard_batch(mesh, b))
+        o_state, ol = o_step(o_state, dp.shard_batch(mesh, b))
+        np.testing.assert_allclose(float(ol), float(zl), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(z_state.params),
+                    jax.tree.leaves(o_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6, rtol=1e-5)
+
+    g_state = dp.replicate(mesh, dp.init_state(
+        llama.init_llama(jax.random.key(0), cfg), optax.adam(1e-3)))
+    g_step = dp.make_grad_aggregation_step(loss_fn, optax.adam(1e-3), mesh)
+    og_state, og_step = compress.make_overlap_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg),
+        microbatches=1, wire="fp32", aggregation="gradient")
+    for b in batches:
+        g_state, gl = g_step(g_state, dp.shard_batch(mesh, b))
+        og_state, ogl = og_step(og_state, dp.shard_batch(mesh, b))
+        np.testing.assert_allclose(float(ogl), float(gl), rtol=1e-6)
+
+
+def test_overlap_int8_converges_on_quadratic():
+    """int8 in-flight ring chunks + int8 second leg with EF converge on
+    the convex problem (the existing int8 path's bar), at M=2 where the
+    microbatch pipeline and the per-hop quantization are both live."""
+    mesh = _mesh2()
+    params, loss_fn, batch, _ = _quadratic_setup(jax.random.key(3))
+    for agg in ("gradient", "zero1"):
+        state, step = compress.make_overlap_step(
+            loss_fn, optax.sgd(0.05), mesh,
+            jax.tree.map(jnp.copy, params), microbatches=2,
+            wire="int8_ef", aggregation=agg)
+        sb = dp.shard_batch(mesh, batch)
+        losses = []
+        for _ in range(60):
+            state, loss = step(state, sb)
+            losses.append(float(loss))
+        assert losses[-1] < 1e-2 * losses[0], (agg, losses[0], losses[-1])
+
+
+def test_overlap_replicas_stay_bitwise_identical(devices):
+    """Every wire format broadcasts ONE payload all shards apply
+    identically, so the replicated params must stay bitwise in sync —
+    the invariant that makes the quantized second leg sound."""
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+
+    mesh = _mesh4(devices)
+    cfg = LlamaConfig(vocab_size=64, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=8)
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    batch = jax.random.randint(jax.random.key(1), (8, 8), 0, 64)
+    for wire in ("fp32", "bf16", "int8_ef"):
+        for agg in ("gradient", "zero1"):
+            state, step = compress.make_overlap_step(
+                loss_fn, optax.adam(1e-3), mesh,
+                llama.init_llama(jax.random.key(0), cfg),
+                microbatches=2, wire=wire, aggregation=agg)
+            for _ in range(2):
+                state, _ = step(state, dp.shard_batch(mesh, batch))
+            for leaf in jax.tree.leaves(state.params):
+                shards = [np.asarray(s.data)
+                          for s in leaf.addressable_shards]
+                for s in shards[1:]:
+                    np.testing.assert_array_equal(shards[0], s)
+
+
+def test_overlap_ef_residual_exact_through_preempt_resume(devices):
+    """The acceptance bar: an int8+EF overlap run (zero1, K=2) interrupted
+    at a chunk edge and resumed from its checkpoint walks BITWISE the
+    uninterrupted trajectory — possible only if both EF residual trees
+    restore exactly (a zeroed residual would shift every loss after the
+    resume point)."""
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train import train_llm_dp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    base = dict(batch_size=2, seq_len=16, lr=3e-3, data=2, wire="int8_ef",
+                overlap_microbatches=2, steps_per_dispatch=2)
+    mesh = lambda: make_mesh({"data": 2}, devices=devices[:2])  # noqa: E731
+
+    ref = train_llm_dp(cfg, TrainConfig(**base, iters=6),
+                       tokenizer=ByteTokenizer(), aggregation="zero1",
+                       mesh=mesh(), log_every=0)
+    import tempfile
+    d = tempfile.mkdtemp()
+    a = train_llm_dp(cfg, TrainConfig(**base, iters=4),
+                     tokenizer=ByteTokenizer(), aggregation="zero1",
+                     mesh=mesh(), log_every=0, checkpoint_dir=d,
+                     checkpoint_every=100)
+    b = train_llm_dp(cfg, TrainConfig(**base, iters=6),
+                     tokenizer=ByteTokenizer(), aggregation="zero1",
+                     mesh=mesh(), log_every=0, checkpoint_dir=d,
+                     checkpoint_every=100)
+    assert a.losses + b.losses == ref.losses
+
+
+def test_int8_ef_legacy_resume_preserves_residual(devices):
+    """Satellite pin: the legacy per-step int8+EF path's residual IS part
+    of checkpointed state (EFTrainState rides the checkpointer whole) —
+    a mid-run preemption must not silently drop accumulated quantization
+    error, proven by bitwise trajectory equality across a resume."""
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train import train_llm_dp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    base = dict(batch_size=2, seq_len=16, lr=3e-3, data=2, wire="int8_ef")
+    mesh = lambda: make_mesh({"data": 2}, devices=devices[:2])  # noqa: E731
+
+    ref = train_llm_dp(cfg, TrainConfig(**base, iters=6),
+                       tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0)
+    import tempfile
+    d = tempfile.mkdtemp()
+    a = train_llm_dp(cfg, TrainConfig(**base, iters=3),
+                     tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0,
+                     checkpoint_dir=d, checkpoint_every=100)
+    b = train_llm_dp(cfg, TrainConfig(**base, iters=6),
+                     tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0,
+                     checkpoint_dir=d, checkpoint_every=100)
+    assert a.losses + b.losses == ref.losses
+
+
+def test_overlap_trainer_composition_and_guards(devices):
+    """Trainer-level composition: overlap_microbatches=2 + bf16 wire +
+    zero1 + steps_per_dispatch=2 trains finite and matches its own
+    per-step-dispatch run bitwise; invalid compositions fail loudly."""
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train import train_llm_dp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    base = dict(batch_size=2, seq_len=16, iters=4, lr=3e-3, data=2,
+                wire="bf16", overlap_microbatches=2)
+    mesh = lambda: make_mesh({"data": 2}, devices=devices[:2])  # noqa: E731
+    ref = train_llm_dp(cfg, TrainConfig(**base), tokenizer=ByteTokenizer(),
+                       aggregation="zero1", mesh=mesh(), log_every=0)
+    got = train_llm_dp(cfg, TrainConfig(**base, steps_per_dispatch=2),
+                       tokenizer=ByteTokenizer(), aggregation="zero1",
+                       mesh=mesh(), log_every=0)
+    assert got.losses == ref.losses
+    assert all(np.isfinite(ref.losses))
+
+    with pytest.raises(ValueError, match="zero1 aggregation only"):
+        train_llm_dp(cfg, TrainConfig(**base), tokenizer=ByteTokenizer(),
+                     aggregation="weight", mesh=mesh(), log_every=0)
+    with pytest.raises(ValueError, match="accum_steps"):
+        train_llm_dp(cfg, TrainConfig(**base, accum_steps=2),
+                     tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0)
+    with pytest.raises(ValueError, match="numerics_every"):
+        train_llm_dp(cfg, TrainConfig(**base, numerics_every=2),
+                     tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0)

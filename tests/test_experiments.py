@@ -171,3 +171,51 @@ def test_vfl_faithful_freezes_bottoms():
              for a, b in zip(jax.tree.leaves(init["top"]),
                              jax.tree.leaves(params["top"]))]
     assert all(moved)
+
+
+def test_bench_compare_direction_aware_gating(tmp_path):
+    """bench_compare judges wire_bytes_* rows lower-is-better: a candidate
+    ABOVE the best (lowest) committed row regresses, one below improves —
+    while throughput rows keep their higher-is-better direction (the
+    satellite fix: a wire-bytes regression must gate, not pass as an
+    'improvement')."""
+    import json
+
+    from experiments.bench_compare import compare, lower_is_better
+
+    assert lower_is_better("wire_bytes_per_train_step")
+    assert lower_is_better("payload_bytes_per_step")
+    assert not lower_is_better("tiny_llama_train_tokens_per_sec_per_chip")
+
+    def row(metric, value):
+        return json.dumps({"metric": metric, "value": value,
+                           "platform": "cpu", "variant": "v"})
+
+    committed = str(tmp_path / "BENCH_r01.json")
+    with open(committed, "w") as f:
+        f.write(row("wire_bytes_per_train_step", 100.0) + "\n"
+                + row("tps", 1000.0) + "\n")
+
+    # Wire bytes UP 100% -> regression; throughput up is never one.
+    worse = str(tmp_path / "cand_worse.json")
+    with open(worse, "w") as f:
+        f.write(row("wire_bytes_per_train_step", 200.0) + "\n"
+                + row("tps", 2000.0) + "\n")
+    _, regressions = compare([committed], worse, 20.0)
+    assert len(regressions) == 1
+    assert "wire_bytes_per_train_step" in regressions[0]
+    assert "above best" in regressions[0]
+
+    # Wire bytes DOWN is the improvement the lever exists for.
+    better = str(tmp_path / "cand_better.json")
+    with open(better, "w") as f:
+        f.write(row("wire_bytes_per_train_step", 25.0) + "\n")
+    _, regressions = compare([committed], better, 20.0)
+    assert regressions == []
+
+    # Throughput still gates downward.
+    slow = str(tmp_path / "cand_slow.json")
+    with open(slow, "w") as f:
+        f.write(row("tps", 100.0) + "\n")
+    _, regressions = compare([committed], slow, 20.0)
+    assert len(regressions) == 1 and "below best" in regressions[0]

@@ -1097,3 +1097,73 @@ def test_stream_tailer_from_end_survives_heal_shrink(tmp_path):
     with open(path, "ab") as f:                     # ...and writes anew
         f.write(b'{"type": "request_enqueue", "req": "alive"}\n')
     assert [e["req"] for e in t.poll()] == ["alive"]
+
+
+# ------------------------------------- overlap ring accounting (ISSUE 10)
+
+def test_comm_ring_accounting_matches_analytic(devices):
+    """The ring driver's comm profile is EXACT: ppermute trip counts ×
+    chunk payloads reproduce the analytic K·M·(n−1)·chunk_bytes wire
+    formula to the byte per wire format (ppermute ring factor 1 — one
+    neighbor send per trip), and the int8 scale sidecars account
+    K·M·(n−1)·4 bytes."""
+    import optax
+
+    from ddl25spring_tpu.parallel.dp import _flat_geometry
+
+    n, K, M = 4, 2, 2
+    mesh = make_mesh({"data": n}, devices=devices[:n])
+    params = llama.init_llama(jax.random.key(0), TINY)
+    _, _, local, _ = _flat_geometry(mesh, params)
+    window = jax.ShapeDtypeStruct((K, n * 2, TINY.ctx_size), jnp.int32)
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, TINY)
+
+    for wire, itemsize in (("fp32", 4), ("bf16", 2), ("int8_ef", 1)):
+        state, step = compress.make_overlap_multi_step(
+            loss_fn, optax.adam(1e-3), mesh,
+            llama.init_llama(jax.random.key(0), TINY),
+            microbatches=M, wire=wire, aggregation="zero1")
+        profile = measure_comm(step, state, window)
+        assert profile is not None and profile.records
+        by = profile.by_label()
+        suffix = {"fp32": "f32", "bf16": "bf16", "int8_ef": "int8"}[wire]
+        ring = by[f"ring_grad_{suffix}"]
+        want = K * M * (n - 1) * local * itemsize
+        assert ring["payload_bytes"] == want, (wire, ring)
+        assert ring["calls"] == K * M * (n - 1)
+        # ppermute ring factor is exactly 1: wire bytes == payload bytes.
+        assert ring["wire_bytes_per_device"] == want
+        if wire == "int8_ef":
+            scales = by["ring_grad_scale"]
+            assert scales["payload_bytes"] == K * M * (n - 1) * 4
+            # The compressed second leg (delta gather) is int8 too.
+            assert by["overlap_delta_gather_int8"]["payload_bytes"] == \
+                K * local * 1
+
+
+def test_as_dict_overlap_normalization_rule():
+    """The normalization rule, pinned once so future drivers can't
+    double-count: per-TRAIN-STEP figures divide the per-dispatch totals
+    by steps_per_dispatch ONLY — an overlap step's M microbatch rings are
+    that step's traffic, so dividing by M too would under-count M×. The
+    per-microbatch-ring view is an ADDITIONAL field (÷M on top)."""
+    from ddl25spring_tpu.telemetry.comm import CommProfile, CommRecord
+    K, M = 4, 2
+    # One ring hop traced per microbatch (unrolled), each executing K
+    # times per dispatch: 2 records at scale=K.
+    records = [CommRecord(op="ppermute", label="ring_grad_f32",
+                          axis="data", axis_size=2, payload_bytes=100,
+                          scale=K)
+               for _ in range(M)]
+    p = CommProfile(records)
+    d = p.as_dict(steps_per_dispatch=K, overlap_microbatches=M)
+    assert d["wire_bytes_per_device_per_step"] == K * M * 100
+    assert d["wire_bytes_per_device_per_train_step"] == M * 100   # ÷K only
+    assert d["wire_bytes_per_device_per_microbatch"] == 100       # ÷K÷M
+    assert d["overlap_microbatches"] == M
+    # M = 1 adds nothing: the legacy dict shape is unchanged.
+    d1 = p.as_dict(steps_per_dispatch=K)
+    assert "overlap_microbatches" not in d1
+    assert "wire_bytes_per_device_per_microbatch" not in d1
