@@ -1,6 +1,6 @@
 """Production serving layer: continuous batching over a paged KV cache.
 
-Four layers (ISSUE 6 / ROADMAP item 2), bottom-up:
+Six layers (ISSUE 6 + ISSUE 11 / ROADMAP item 1), bottom-up:
 
 - kvcache   — fixed-size device block pool + host free-list allocator;
               sequences of different lengths share one pool through
@@ -8,20 +8,35 @@ Four layers (ISSUE 6 / ROADMAP item 2), bottom-up:
               cache (vLLM-style paging, static-shape/one-compile).
 - engine    — ``prefill_chunk`` / ``decode_step`` compiled ONCE over a
               fixed slot axis; chunked prefill interleaves with in-flight
-              decode; bitwise-parity with ``models.generate`` pinned in
-              tests.
-- scheduler — Orca-style iteration-level (continuous) batching: FCFS
-              admission with worst-case block reservation (never
-              deadlocks), retirement frees blocks at the next token
-              boundary; ``request_*`` telemetry events.
-- frontend  — seeded Poisson load generator (mixed prompt/output length
-              mixtures) + ``run_serving`` driver and the latency
-              aggregation behind bench.py's serving row and
-              ``experiments/obs_report.py``'s serving section.
+              decode; token-boundary weight hot-swap seam
+              (``swap_params``); bitwise-parity with ``models.generate``
+              pinned in tests.
+- scheduler — Orca-style iteration-level (continuous) batching:
+              reservation-based admission (never deadlocks) behind a
+              policy seam (FCFS default; size-aware "sjf"; priorities),
+              retirement frees blocks at the next token boundary;
+              ``request_*`` telemetry events, per-engine tagged.
+- frontend  — seeded Poisson load generator, now multi-tenant
+              (``TrafficClass`` / ``multi_tenant_workload``: per-class
+              rates, SLO targets, admission priorities) + ``run_serving``
+              driver and the latency aggregation behind bench.py's
+              serving row and ``experiments/obs_report.py``.
+- fleet     — N engines behind an SLO-aware ``Router`` (least-loaded /
+              predicted-TTFT over slo_monitor-shaped rolling windows)
+              with live weight hot-swap rolled out one engine per token
+              boundary; ``run_serving_fleet`` driver.
+- deploy    — the train→deploy conveyor: ``CheckpointPublisher`` (the
+              trainer's ``on_checkpoint`` hook, params-only checkpoint
+              stream) and ``WeightPublisher`` (digest-verified,
+              restore-at-saved-shapes watcher feeding the fleet).
 """
 
+from .deploy import CheckpointPublisher, WeightPublisher  # noqa: F401
 from .engine import Engine, TokenEvent  # noqa: F401
-from .frontend import (ServingReport, aggregate_latency,  # noqa: F401
+from .fleet import (FleetReport, Router, ServingFleet,  # noqa: F401
+                    run_serving_fleet)
+from .frontend import (ServingReport, TrafficClass,  # noqa: F401
+                       aggregate_latency, class_slos, multi_tenant_workload,
                        reference_stream, run_serving, synthetic_workload)
 from .kvcache import (TRASH_BLOCK, BlockAllocator,  # noqa: F401
                       PagedKVConfig, blocks_for, init_pool,

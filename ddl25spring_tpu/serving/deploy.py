@@ -1,0 +1,149 @@
+"""Live train→deploy weight publication: checkpoint stream → serving fleet.
+
+The loop-closing half of the fleet subsystem (serving/fleet.py): the
+trainer keeps checkpointing as it always has, and the serving fleet keeps
+serving — this module is the conveyor between them, built entirely from
+checkpoint.py's existing machinery so a publication inherits every
+robustness property checkpoints already have (atomic orbax commits,
+SHA-256 digest verification before restore, corrupt-step fallback,
+restore-at-saved-shapes cross-topology resharding).
+
+Two halves, one directory:
+
+- ``CheckpointPublisher`` (trainer side) — the ``on_checkpoint`` hook
+  ``train_llm_dp`` calls after every periodic/final save: extracts the
+  PARAMS from the train state and saves them as a params-only checkpoint
+  step in the publish directory. Params-only on purpose: the serving
+  side must never need the trainer's optimizer-state template (whose
+  ZeRO-1 moments are sharded to a world size serving doesn't have), and
+  a params tree is what ``Engine.swap_params`` takes. Never raises into
+  the trainer — a failed publication is logged and dropped, the same
+  never-sink-the-run posture as telemetry.
+
+- ``WeightPublisher`` (serving side) — watches the publish directory:
+  ``poll()`` returns ``(step, params)`` when a step newer than the last
+  publication restores cleanly (digest-verified; a corrupt newest step
+  falls back to the next, exactly like a trainer resume), restored
+  through ``Checkpointer.restore`` against the serving engine's own
+  params template — the restore-at-saved-shapes path, so a tree saved
+  under a different topology reshards instead of truncating.
+  ``publish_to(fleet)`` hands a fresh tree to ``ServingFleet.publish``,
+  which rolls it out one engine per token boundary (fleet.py) — the
+  fleet is never globally idle across a publish, and no stream drops.
+
+The smoke (`experiments/serving_bench.py --engines N --hot-swap`) drives
+the full loop: params → publish dir → digest-verified restore →
+staggered per-engine swap mid-traffic, with the bitwise bar held (same
+weights) and the ``deploy`` events/spans in the stream as evidence.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Tuple
+
+
+def _default_params_of(state: Any):
+    """TrainState-shaped trees carry ``.params``; a bare params tree (or
+    anything unshaped) publishes as-is."""
+    return getattr(state, "params", state)
+
+
+class CheckpointPublisher:
+    """Trainer-side publication hook (``train_llm_dp(on_checkpoint=...)``).
+
+    >>> pub = CheckpointPublisher(publish_dir)
+    >>> train_llm_dp(cfg, tcfg, checkpoint_dir=ckpt_dir,
+    ...              checkpoint_every=200, on_checkpoint=pub)
+
+    Each call saves ``params_of(state)`` at the checkpoint's step index
+    and WAITS for the write to land (publications are off the hot path —
+    checkpoint cadence — and a landed step is digest-manifested, so the
+    watching ``WeightPublisher`` only ever sees verifiable bytes).
+    ``max_to_keep=2`` keeps the dir O(1): the newest publication plus one
+    fallback for a corrupt-newest restore."""
+
+    def __init__(self, publish_dir: str, *,
+                 params_of: Callable[[Any], Any] = _default_params_of,
+                 max_to_keep: int = 2,
+                 log_fn: Callable[[str], None] = print):
+        from ..checkpoint import Checkpointer
+        self.publish_dir = publish_dir
+        self._params_of = params_of
+        self._log = log_fn
+        self._ckpt = Checkpointer(publish_dir, max_to_keep=max_to_keep)
+        self.published: List[int] = []
+
+    def __call__(self, step: int, state: Any) -> None:
+        try:
+            self._ckpt.save(int(step), self._params_of(state), force=True,
+                            overwrite=True)
+            self._ckpt.wait()      # land + digest-manifest before visible
+            self.published.append(int(step))
+        except Exception as e:     # publication must never sink the trainer
+            self._log(f"weight publication at step {step} failed "
+                      f"({type(e).__name__}: {e}); training continues")
+
+    def close(self) -> None:
+        try:
+            self._ckpt.close()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "CheckpointPublisher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class WeightPublisher:
+    """Serving-side watcher over a publish directory (class docstring
+    above): ``poll()`` → newest fresh ``(step, params)`` or None;
+    ``publish_to(fleet)`` → poll and hand off as a staggered hot-swap.
+
+    A FRESH ``Checkpointer`` is opened per poll and closed after: the
+    writer is another process, and orbax's step listing is snapshotted
+    per manager — reopening is what makes newly landed steps visible.
+    Poll cadence is the caller's (publications arrive at checkpoint
+    cadence, so per-token polling would be absurd; the smoke polls once,
+    a sidecar would poll on the order of seconds)."""
+
+    def __init__(self, publish_dir: str, template_params: Any):
+        self.publish_dir = publish_dir
+        self.template = template_params
+        self.last_step: Optional[int] = None
+
+    def poll(self) -> Optional[Tuple[int, Any]]:
+        from ..checkpoint import Checkpointer
+        if not os.path.isdir(self.publish_dir):
+            return None               # nothing published yet
+        ckpt = Checkpointer(self.publish_dir)
+        try:
+            latest = ckpt.latest_step()
+            if latest is None or (self.last_step is not None
+                                  and latest <= self.last_step):
+                return None
+            # Digest-verify + restore-at-saved-shapes + corrupt-newest
+            # fallback, all checkpoint.py's: the step that actually
+            # restored is ``restored_step`` (≤ latest), and a fallback
+            # onto something already published is NOT a new publication.
+            params = ckpt.restore(self.template)
+            step = int(ckpt.restored_step)
+        finally:
+            ckpt.close()
+        if self.last_step is not None and step <= self.last_step:
+            return None
+        self.last_step = step
+        return step, params
+
+    def publish_to(self, fleet) -> Optional[int]:
+        """Poll; on a fresh publication, start the fleet's staggered
+        rollout (``ServingFleet.publish``) versioned by the trainer's
+        step. Returns the published step, or None when nothing new."""
+        got = self.poll()
+        if got is None:
+            return None
+        step, params = got
+        fleet.publish(params, version=step)
+        return step

@@ -55,6 +55,48 @@ from .kvcache import (TRASH_BLOCK, BlockAllocator, PagedKVConfig, blocks_for,
                       init_pool)
 
 
+def check_swappable(old, new) -> None:
+    """Raise unless ``new`` matches ``old`` leaf-for-leaf in tree
+    structure, shape and dtype — the equal-tree contract every weight
+    hot-swap must satisfy (a mismatch would silently retrace the two
+    compiled programs). Shared by ``Engine.swap_params`` (per-engine
+    enforcement) and ``ServingFleet.publish`` (fail a bad publish
+    ATOMICALLY, before any engine pops from the rollout)."""
+    o_leaves, o_def = jax.tree_util.tree_flatten(old)
+    n_leaves, n_def = jax.tree_util.tree_flatten(new)
+    if o_def != n_def:
+        raise ValueError("swap_params: new params tree structure does "
+                         "not match the serving engine's")
+    for o, n in zip(o_leaves, n_leaves):
+        if o.shape != n.shape or o.dtype != n.dtype:
+            raise ValueError(
+                f"swap_params: leaf mismatch {n.shape}/{n.dtype} vs "
+                f"engine's {o.shape}/{o.dtype} — a shape change would "
+                "retrace the engine's two compiled programs")
+
+
+def _match_placement(new, old):
+    """Return ``new`` placed EXACTLY like ``old`` (device + committed-ness,
+    leaf by leaf). The jit cache key includes argument placement, so a
+    hot-swapped tree must be indistinguishable in placement from the boot
+    params or both compiled programs would silently retrace — and a tree
+    restored from a checkpoint arrives device_put-COMMITTED while
+    ``init_llama``'s boot params are uncommitted. Shedding a commitment
+    requires a host bounce (there is no uncommit-in-place); that is one
+    params-sized copy per publish, trivial next to the disk read that
+    produced the tree."""
+    def fix(n, o):
+        if not isinstance(n, jax.Array) or not isinstance(o, jax.Array):
+            return n
+        nc = bool(getattr(n, "committed", False))
+        oc = bool(getattr(o, "committed", False))
+        if oc:
+            return n if nc and n.sharding == o.sharding \
+                else jax.device_put(n, o.sharding)
+        return n if not nc else jnp.asarray(np.asarray(n))
+    return jax.tree.map(fix, new, old)
+
+
 # ------------------------------------------------------------- paged forward
 
 def _attend_paged(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
@@ -285,7 +327,8 @@ class Engine:
 
     def __init__(self, params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                  num_slots: int, *, prefill_chunk: int = 16,
-                 top_k: Optional[int] = None, top_p: Optional[float] = None):
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 engine_id: Optional[int] = None):
         if num_slots < 1 or prefill_chunk < 1:
             raise ValueError(f"num_slots={num_slots}, "
                              f"prefill_chunk={prefill_chunk}")
@@ -293,6 +336,11 @@ class Engine:
         self.paged = paged
         self.num_slots = num_slots
         self.prefill_chunk_len = prefill_chunk
+        # Fleet seam (serving/fleet.py): which replica this engine is.
+        # Purely a label — it tags the compile-watch names below (so an
+        # N-engine run's 2N compile events attribute per engine) and rides
+        # through the scheduler into request_*/route/deploy telemetry.
+        self.engine_id = engine_id
         self.params = params
         self.fused = generate._fuse_blocks(params["blocks"])  # hoisted once
         self.pool = init_pool(cfg, paged)
@@ -317,12 +365,13 @@ class Engine:
         # flagged retrace) and emit ``compile`` events once the scheduler
         # binds its event stream (introspect.bind_events).
         from ..telemetry import introspect
+        tag = "" if engine_id is None else f"[{engine_id}]"
         self._prefill = introspect.watch(
             make_prefill_chunk(cfg, paged, prefill_chunk, top_k, top_p),
-            name="serving/prefill_chunk", max_caches=1)
+            name=f"serving/prefill_chunk{tag}", max_caches=1)
         self._decode = introspect.watch(
             make_decode_step(cfg, paged, num_slots, top_k, top_p),
-            name="serving/decode_step", max_caches=1)
+            name=f"serving/decode_step{tag}", max_caches=1)
 
     # ------------------------------------------------------------- admission
     def required_blocks(self, prompt_len: int, max_new: int) -> int:
@@ -383,6 +432,38 @@ class Engine:
 
     def blocks_in_use(self) -> int:
         return self.allocator.in_use
+
+    # ------------------------------------------------------- weight hot-swap
+    def swap_params(self, params: dict, *, fused: Optional[dict] = None
+                    ) -> None:
+        """Swap to new weights at the CURRENT token boundary — the live
+        train→deploy seam (serving/deploy.py). Legal between ``step()``
+        calls only (the host drives the engine, so outside a ``step()``
+        nothing is in flight by construction); in-flight streams are NOT
+        dropped — their next token is sampled under the new weights over
+        the KV each slot already wrote, and nothing already emitted
+        changes (the hot-swap determinism bar in
+        tests/test_fleet_serving.py: a same-weights swap is bitwise
+        invisible; a new-weights swap changes only tokens sampled after
+        the boundary).
+
+        The new tree must match the old one leaf-for-leaf in shape and
+        dtype: params are DATA to the two compiled programs, so an equal
+        tree swaps with zero recompiles (the engine's two-programs
+        contract survives any number of publishes), while a different
+        shape would silently retrace — rejected loudly instead. Placement
+        is normalized to the boot params' (``_match_placement``) for the
+        same reason: a checkpoint-restored tree arrives committed, and
+        committed-ness is part of the jit cache key.
+
+        ``fused`` (the ``generate._fuse_blocks`` view of ``params``) can
+        be passed precomputed so an N-engine fleet fuses once per publish,
+        not once per engine."""
+        check_swappable(self.params, params)
+        self.params = _match_placement(params, self.params)
+        self.fused = (_match_placement(fused, self.fused)
+                      if fused is not None
+                      else generate._fuse_blocks(self.params["blocks"]))
 
     def step(self) -> List[TokenEvent]:
         """One token boundary: one prefill chunk (if a slot is mid-prefill),

@@ -3,10 +3,13 @@
 The load half of the serving subsystem: a seeded Poisson arrival process
 over mixed prompt/output length distributions (`synthetic_workload` — the
 "millions of users" stand-in the north star asks to be measured against),
-and `run_serving`, the driver that replays such a workload through the
-continuous-batching scheduler in (fast-forwarded) real time and aggregates
-per-request latency into the serving headline: sustained tok/s + p50/p95/
-p99 queue wait and TTFT at N concurrent streams.
+its multi-tenant generalization (`TrafficClass`/`multi_tenant_workload`:
+one Poisson stream per class with its own rates, admission priority and
+per-class SLO targets, merged arrival-ordered — the fleet's traffic,
+serving/fleet.py), and `run_serving`, the driver that replays a workload
+through the continuous-batching scheduler in (fast-forwarded) real time
+and aggregates per-request latency into the serving headline: sustained
+tok/s + p50/p95/p99 queue wait and TTFT at N concurrent streams.
 
 Determinism contract: the workload is fully determined by its seed (one
 `np.random.default_rng` drives arrivals, lengths, temperatures, prompt
@@ -48,6 +51,8 @@ def synthetic_workload(*, seed: int, n_requests: int, rate_rps: float,
                        max_new_weights: Optional[Sequence[float]] = None,
                        temperatures: Sequence[float] = (0.0, 0.8),
                        temperature_weights: Optional[Sequence[float]] = None,
+                       tenant: str = "default", priority: int = 0,
+                       rid_prefix: str = "req",
                        ) -> List[Request]:
     """Seeded Poisson arrivals (exponential inter-arrival at ``rate_rps``)
     over mixed prompt/output length and temperature mixtures.
@@ -69,11 +74,67 @@ def synthetic_workload(*, seed: int, n_requests: int, rate_rps: float,
         temp = float(rng.choice(np.asarray(temperatures, np.float64),
                                 p=temperature_weights))
         prompt = tuple(int(x) for x in rng.integers(0, vocab_size, tp))
-        reqs.append(Request(rid=f"req-{i:04d}", prompt=prompt, max_new=mx,
-                            temperature=temp,
+        reqs.append(Request(rid=f"{rid_prefix}-{i:04d}", prompt=prompt,
+                            max_new=mx, temperature=temp,
                             seed=int(rng.integers(0, 2 ** 31 - 1)),
-                            arrival=t))
+                            arrival=t, tenant=tenant, priority=priority))
     return reqs
+
+
+@dataclass(frozen=True)
+class TrafficClass:
+    """One tenant class of a multi-tenant workload: its own Poisson rate,
+    length/temperature mixture, admission ``priority`` (higher admits
+    first at a contended boundary — scheduler.py), and optional per-class
+    SLO targets (consumed by ``experiments/slo_monitor.py``'s per-class
+    verdicts and the fleet smoke). A class is a traffic SHAPE: counts
+    belong to the ``multi_tenant_workload`` call."""
+    name: str
+    rate_rps: float
+    prompt_lens: Sequence[int] = (8, 16, 48)
+    max_news: Sequence[int] = (8, 16, 32)
+    temperatures: Sequence[float] = (0.0, 0.8)
+    priority: int = 0
+    ttft_p99_s: Optional[float] = None
+    queue_p99_s: Optional[float] = None
+
+
+def multi_tenant_workload(*, seed: int, classes: Sequence[TrafficClass],
+                          n_per_class, vocab_size: int) -> List[Request]:
+    """Merge one seeded Poisson stream per traffic class into a single
+    arrival-ordered workload. Each class draws from its own child seed
+    (derived from ``seed`` and the class position), so adding a class
+    never perturbs another's stream; request ids are ``<class>-<i>`` and
+    every request carries its class name as ``tenant`` plus the class
+    ``priority``. ``n_per_class`` is an int (same count for every class)
+    or a ``{name: count}`` mapping."""
+    reqs: List[Request] = []
+    for idx, cls in enumerate(classes):
+        n = (n_per_class[cls.name] if isinstance(n_per_class, dict)
+             else int(n_per_class))
+        reqs.extend(synthetic_workload(
+            seed=seed + 7919 * (idx + 1), n_requests=n,
+            rate_rps=cls.rate_rps, vocab_size=vocab_size,
+            prompt_lens=cls.prompt_lens, max_news=cls.max_news,
+            temperatures=cls.temperatures, tenant=cls.name,
+            priority=cls.priority, rid_prefix=cls.name))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
+def class_slos(classes: Sequence[TrafficClass]) -> Dict[str, Dict[str, float]]:
+    """The per-class SLO table in ``experiments/slo_monitor.py``'s
+    ``SLOConfig.per_class`` shape: {class: {objective: threshold}},
+    classes with no targets omitted."""
+    out: Dict[str, Dict[str, float]] = {}
+    for cls in classes:
+        limits = {}
+        if cls.ttft_p99_s is not None:
+            limits["ttft_p99_s"] = cls.ttft_p99_s
+        if cls.queue_p99_s is not None:
+            limits["queue_p99_s"] = cls.queue_p99_s
+        if limits:
+            out[cls.name] = limits
+    return out
 
 
 def reference_stream(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
@@ -154,10 +215,24 @@ def aggregate_latency(records: Dict[str, RequestRecord],
     first admission → last completion, which is only honest when the
     clock contains no fast-forwarded idle gaps (record timestamps come
     from the skewed clock, so under sparse load the fallback would count
-    jumped idle time as serving time and deflate the figure)."""
+    jumped idle time as serving time and deflate the figure).
+
+    Always returns the FULL record shape: an empty (or all-in-flight)
+    window yields ``completed: 0`` with ``None`` percentiles and rates,
+    and a single-request window yields its degenerate percentiles —
+    never a key-missing dict callers must special-case. The fleet's
+    per-class/per-engine slices make empty windows a legitimate steady
+    state (a quiet tenant, an engine mid-rollout), so the shape contract
+    is pinned (tests/test_fleet_serving.py)."""
+    pct = lambda vals: {f"p{q:g}": (percentile(vals, q) if vals else None)
+                        for q in (50, 95, 99)}
     done = [r for r in records.values() if r.done_t is not None]
     if not done:
-        return {"completed": 0}
+        return {"completed": 0, "total_tokens": 0,
+                "sustained_tokens_per_sec": None,
+                "busy_span_s": busy_span_s,
+                "queue_wait_s": pct([]), "ttft_s": pct([]),
+                "request_tokens_per_sec": pct([])}
     waits = [r.queue_wait_s for r in done if r.queue_wait_s is not None]
     ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
     rates = [r.tokens_per_sec for r in done if r.tokens_per_sec is not None]
@@ -165,8 +240,6 @@ def aggregate_latency(records: Dict[str, RequestRecord],
     span = busy_span_s if busy_span_s is not None else (
         max(r.done_t for r in done)
         - min(r.admit_t for r in done if r.admit_t is not None))
-    pct = lambda vals: {f"p{q:g}": percentile(vals, q)
-                        for q in (50, 95, 99)} if vals else {}
     return {
         "completed": len(done),
         "total_tokens": total_tokens,

@@ -7,7 +7,8 @@ requests into freed slots, advancing one prefill chunk, decoding one token
 for everyone in flight, and retiring finished sequences (their blocks
 return to the pool immediately).
 
-Admission policy — FCFS with worst-case reservation (the documented seam):
+Admission policy — reservation-based, FCFS by default (the documented
+seam, now a config knob):
 - ``admit`` reserves a request's worst-case block count up front
   (``Engine.required_blocks``), all-or-nothing. An admitted request can
   therefore ALWAYS run to completion: pool exhaustion can only delay
@@ -18,11 +19,24 @@ Admission policy — FCFS with worst-case reservation (the documented seam):
   short-stopping request never writes sit reserved until retirement.
   vLLM's alternative — allocate lazily per block, preempt-and-recompute a
   victim on exhaustion — buys that utilization back at the price of
-  recompute; swap `_try_admit` (and add victim selection) to explore it.
-- Strict FCFS: the queue head blocks the line even when a smaller request
-  behind it would fit. Keeping arrival order makes queue-wait percentiles
-  meaningful under the Poisson load harness; size-aware admission is a
-  one-line change at the same seam.
+  recompute; swap `_admit` (and add victim selection) to explore it.
+- ``admission="fcfs"`` (default): strict arrival order — the queue head
+  blocks the line even when a smaller request behind it would fit.
+  Keeping arrival order makes queue-wait percentiles meaningful under
+  the Poisson load harness. This mode is byte-for-byte the pre-knob
+  behavior (pinned in tests/test_fleet_serving.py).
+- ``admission="sjf"``: size-aware — when the pool is tight (the head's
+  reservation doesn't fit but a slot is free), admit the SHORTEST
+  reservation among the same-priority queued requests that does fit,
+  ties broken by arrival. Strictly more admissions per boundary under
+  mixed lengths, at the price of possible head-of-line latency for the
+  large request (its turn still comes: the pool drains toward its
+  reservation, and ``submit`` already rejected anything that could
+  never fit).
+- Priorities (``Request.priority``, higher first): admission considers
+  the highest-priority queued class first, FCFS (or SJF) within it.
+  With every priority equal (the default 0) both modes reduce to their
+  single-class behavior, so single-tenant streams are untouched.
 
 Admission order is a LATENCY decision only: per-slot state (position, RNG
 key, temperature) is carried per sequence and every engine op is
@@ -73,7 +87,10 @@ class Request:
     ``eos_id``: emitting this token retires the request at that token
     boundary, returning ALL its worst-case-reserved blocks immediately
     (the stream up to and including the EOS is still bitwise
-    ``generate()``'s, which has no early stop — see ``Scheduler.tick``)."""
+    ``generate()``'s, which has no early stop — see ``Scheduler.tick``).
+    ``tenant`` names the traffic class (frontend.TrafficClass) for
+    per-class SLO accounting; ``priority`` orders admission (higher
+    first) — both are latency knobs only, never token knobs."""
     rid: str
     prompt: Tuple[int, ...]
     max_new: int
@@ -81,6 +98,8 @@ class Request:
     seed: int = 0
     arrival: float = 0.0
     eos_id: Optional[int] = None
+    tenant: str = "default"
+    priority: int = 0
 
 
 @dataclass
@@ -91,6 +110,8 @@ class RequestRecord:
     prompt_len: int
     max_new: int
     blocks: int = 0
+    tenant: str = "default"
+    engine: Optional[int] = None   # fleet: which engine served it
     enqueue_t: Optional[float] = None
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
@@ -127,15 +148,33 @@ class Scheduler:
     >>> sched.records[req.rid].tokens
     """
 
-    policy = "fcfs"   # admission-policy seam (module docstring)
-
     def __init__(self, engine: Engine, *, events: Optional[EventLog] = None,
                  token_events: bool = True,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 engine_id: Optional[int] = None,
+                 admission: str = "fcfs"):
+        if admission not in ("fcfs", "sjf"):
+            raise ValueError(f"admission must be 'fcfs' or 'sjf' "
+                             f"(got {admission!r})")
         self.engine = engine
         self.events = events
         self.token_events = token_events
         self.clock = clock
+        # Admission-policy seam (module docstring): "fcfs" is byte-for-byte
+        # the historical behavior; "sjf" is size-aware within a priority.
+        self.policy = admission
+        # Fleet seam: tag every request_* event (and span) with the engine
+        # this scheduler fronts, so an N-engine stream's percentiles can
+        # be grouped per engine (obs_report) instead of pooled.
+        self.engine_id = (engine_id if engine_id is not None
+                          else getattr(engine, "engine_id", None))
+        self._tag = ({"engine": self.engine_id}
+                     if self.engine_id is not None else {})
+        # Completions since the router last harvested (serving/fleet.py's
+        # predicted-TTFT window feed): (done_t, ttft_s) appended at
+        # retirement, drained by Router.harvest — bounded by whoever
+        # consumes it, same O(requests) order as ``records`` without one.
+        self.recent_done: List[Tuple[float, Optional[float]]] = []
         if events is not None:
             # Late-bind the stream to the engine's compile watches: the
             # engine is built before any telemetry exists, but its two
@@ -180,15 +219,17 @@ class Scheduler:
         self.queue.append(req)
         self.records[req.rid] = RequestRecord(
             rid=req.rid, prompt_len=len(req.prompt), max_new=req.max_new,
-            blocks=need, enqueue_t=now)
+            blocks=need, tenant=req.tenant, engine=self.engine_id,
+            enqueue_t=now)
         if self.events:
             self.events.request_enqueue(
                 req=req.rid, prompt_len=len(req.prompt), max_new=req.max_new,
-                temperature=req.temperature, queued=len(self.queue))
+                temperature=req.temperature, queued=len(self.queue),
+                tenant=req.tenant, priority=req.priority, **self._tag)
         if self.tracer:
             root = self.tracer.start("request", trace=req.rid,
                                      prompt_len=len(req.prompt),
-                                     max_new=req.max_new)
+                                     max_new=req.max_new, **self._tag)
             self._spans[req.rid] = {
                 "root": root,
                 "queue": self.tracer.start("queue", parent=root.ctx)}
@@ -247,7 +288,8 @@ class Scheduler:
             if self.events and self.token_events:
                 self.events.request_token(req=req.rid,
                                           i=len(rec.tokens) - 1,
-                                          tok=ev.token, slot=ev.slot)
+                                          tok=ev.token, slot=ev.slot,
+                                          **self._tag)
             done = ev.done
             early_eos = False
             if not done and req.eos_id is not None and ev.token == req.eos_id:
@@ -266,6 +308,7 @@ class Scheduler:
                 rec.done_t = now
                 del self._by_slot[ev.slot]
                 self.completed += 1
+                self.recent_done.append((now, rec.ttft_s))
                 if self.tracer:
                     spans = self._spans.pop(req.rid)
                     self._chunks.pop(req.rid, None)
@@ -288,19 +331,65 @@ class Scheduler:
                         tokens_per_sec=rec.tokens_per_sec,
                         blocks_freed=rec.blocks,
                         blocks_in_use=self.engine.blocks_in_use(),
+                        tenant=req.tenant, **self._tag,
                         **({"eos": True} if early_eos else {}))
             emitted.append((req.rid, ev.token))
         return emitted
 
+    # ---------------------------------------------------------- weight swap
+    def swap_weights(self, params, version, *, fused=None) -> None:
+        """Hot-swap the engine's weights at the CURRENT token boundary
+        (between ``tick()`` calls — the only place this scheduler ever
+        is, host-driven), without touching queued or in-flight requests:
+        their next tokens sample under the new weights, nothing emitted
+        changes, nothing recompiles (``Engine.swap_params`` enforces the
+        equal-tree contract). Emits a ``deploy`` event + span (schema
+        v6) carrying the publication ``version`` and how many streams
+        crossed the swap live."""
+        span = (self.tracer.start("deploy", trace=f"deploy-{version}",
+                                  version=version,
+                                  in_flight=len(self._by_slot),
+                                  queued=len(self.queue), **self._tag)
+                if self.tracer else None)
+        self.engine.swap_params(params, fused=fused)
+        if span is not None:
+            span.end()
+        if self.events:
+            self.events.deploy(version=version,
+                               in_flight=len(self._by_slot),
+                               queued=len(self.queue), **self._tag)
+
     # -------------------------------------------------------------- admission
+    def _pick_admittable(self) -> Optional[int]:
+        """Queue index of the next request to admit under the policy seam
+        (module docstring), or None when nothing admits this boundary.
+        Highest priority class first; within it, FCFS — or, under "sjf"
+        when the class head's reservation doesn't fit, the shortest
+        fitting reservation (ties by arrival)."""
+        top = max(r.priority for r in self.queue)
+        group = [i for i, r in enumerate(self.queue) if r.priority == top]
+        head = self.queue[group[0]]
+        if self.engine.can_admit(len(head.prompt), head.max_new):
+            return group[0]
+        if self.policy == "sjf" and self.engine.free_slot() is not None:
+            fitting = [i for i in group
+                       if self.engine.can_admit(len(self.queue[i].prompt),
+                                                self.queue[i].max_new)]
+            if fitting:
+                return min(fitting,
+                           key=lambda i: (self.records[self.queue[i].rid]
+                                          .blocks, i))
+        return None
+
     def _admit(self) -> None:
-        """Strict FCFS: admit from the head while it fits; stop at the
-        first that doesn't (policy seam — see module docstring)."""
+        """Admit while the policy yields a fitting request; stop when the
+        (priority-ordered) head blocks the line — under "fcfs" that is
+        strict arrival order, byte-for-byte the historical behavior."""
         while self.queue:
-            head = self.queue[0]
-            if not self.engine.can_admit(len(head.prompt), head.max_new):
+            pick = self._pick_admittable()
+            if pick is None:
                 return
-            self.queue.pop(0)
+            head = self.queue.pop(pick)
             key = (jax.random.PRNGKey(head.seed)
                    if head.temperature > 0 else None)
             slot = self.engine.admit(np.asarray(head.prompt, np.int32),
@@ -321,4 +410,5 @@ class Scheduler:
                 self.events.request_prefill(
                     req=head.rid, slot=slot, blocks=rec.blocks,
                     queue_wait_s=rec.queue_wait_s,
-                    blocks_in_use=self.engine.blocks_in_use())
+                    blocks_in_use=self.engine.blocks_in_use(),
+                    **self._tag)

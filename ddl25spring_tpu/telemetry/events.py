@@ -49,10 +49,17 @@ from typing import Any, Dict, Iterator, List, Optional
 # attribution, sampled from the training loop at a configurable cadence)
 # and ``compile`` (one XLA compilation of a watched jit entry point:
 # wall seconds, cache size, retrace flag, HLO flops/bytes for roofline
-# attainment). Version bumps are additive: a v5 reader accepts v1–v4
-# streams unchanged, and older readers reject v5 (the "future schema"
-# rule in validate_event) rather than misread it.
-SCHEMA_VERSION = 5
+# attainment). v6: serving fleet (serving/fleet.py, serving/deploy.py) —
+# ``route`` (one router dispatch decision: which engine a request was
+# handed to, under which policy) and ``deploy`` (one engine's live weight
+# hot-swap at a token boundary: the published version, streams in flight
+# across the swap); ``request_*`` events additionally carry ``engine``
+# (the serving engine id) and ``tenant`` (the traffic class) when emitted
+# by a fleet scheduler — extras, so single-engine v2 streams stay valid.
+# Version bumps are additive: a v6 reader accepts v1–v5 streams
+# unchanged, and older readers reject v6 (the "future schema" rule in
+# validate_event) rather than misread it.
+SCHEMA_VERSION = 6
 
 # Event types this schema version defines. The type set is CLOSED per
 # schema version: ``validate_event`` checks base fields for all types, the
@@ -63,7 +70,7 @@ SCHEMA_VERSION = 5
 EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
                "request_done", "fl_cohort", "fl_tier", "span",
-               "slo_violation", "numerics", "compile")
+               "slo_violation", "numerics", "compile", "route", "deploy")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -120,6 +127,19 @@ _REQUIRED: Dict[str, tuple] = {
     # a gradient went non-finite. Computed INSIDE the compiled step —
     # bitwise-free instrumentation, no extra dispatch.
     "numerics": ("it",),
+    # Serving fleet (serving/fleet.py + serving/deploy.py, schema v6).
+    # ``route`` is one dispatch decision: request ``req`` handed to engine
+    # ``engine`` under ``policy`` ("least_loaded" / "predicted_ttft");
+    # extras carry the decision inputs (per-engine outstanding counts,
+    # predicted TTFT). ``deploy`` is one engine's weight hot-swap at a
+    # token boundary: ``version`` names the publication (the trainer's
+    # checkpoint step for train→deploy publishes), ``engine`` which engine
+    # swapped; extras carry ``in_flight``/``queued`` (the streams that
+    # crossed the swap without dropping) — obs_report renders both, and
+    # the scheduler's ``deploy`` span puts the swap on the Perfetto
+    # timeline.
+    "route": ("req", "engine"),
+    "deploy": ("version",),
     # Compile/retrace accounting (introspect.CompileWatch, schema v5):
     # one event per XLA compilation of a watched jit entry point —
     # ``name`` the factory label, ``seconds`` the compiling call's wall
@@ -336,6 +356,14 @@ class EventLog:
     def compile(self, *, name: str, seconds: float,
                 **fields) -> Dict[str, Any]:
         return self.emit("compile", name=name, seconds=seconds, **fields)
+
+    # Serving fleet (schema v6; serving/fleet.py routes, serving/
+    # scheduler.py swaps).
+    def route(self, *, req: str, engine: int, **fields) -> Dict[str, Any]:
+        return self.emit("route", req=req, engine=engine, **fields)
+
+    def deploy(self, *, version, **fields) -> Dict[str, Any]:
+        return self.emit("deploy", version=version, **fields)
 
     def close(self) -> None:
         with self._lock:

@@ -206,6 +206,23 @@ def _fault_extra(step_fn) -> dict:
     return {"attribution": trip} if trip else {}
 
 
+def _notify_checkpoint(hook, step: int, state, log_fn) -> None:
+    """Checkpoint publication hook (the train→deploy seam,
+    serving/deploy.py): called after every successful periodic/final
+    ``ckpt.save`` with the step index and the live state, so a serving
+    fleet can pick the weights up while this run keeps training. Guarded
+    like telemetry — a broken publisher loses the publication, never the
+    run. Shared by ``_run_loop`` and ``_run_elastic_loop`` so the two
+    cannot drift."""
+    if hook is None:
+        return
+    try:
+        hook(step, state)
+    except Exception as e:
+        log_fn(f"checkpoint publication hook at step {step} failed "
+               f"({type(e).__name__}: {e}); continuing")
+
+
 def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               n_data: int, start_step: int, ckpt, checkpoint_every: int,
               loss_sink, sink_every: int, log_every: int, log_fn,
@@ -213,7 +230,8 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               stats: Optional[ResilienceStats] = None,
               telemetry=None, steps_per_dispatch: int = 1,
               window_shard_fn=None, numerics=None,
-              numerics_every: int = 0, compile_watch=None) -> LLMTrainReport:
+              numerics_every: int = 0, compile_watch=None,
+              on_checkpoint=None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
     and async-honest throughput accounting (the timer starts after
@@ -465,6 +483,8 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                         with _phase("checkpoint", droot, "checkpoint"):
                             ckpt.save(it + 1, state, overwrite=True)
                         last_saved = it + 1
+                        _notify_checkpoint(on_checkpoint, it + 1, state,
+                                           log_fn)
                     except Exception as e:
                         log_fn(f"periodic checkpoint at {it + 1} failed "
                                f"after retries ({type(e).__name__}: {e}); "
@@ -583,6 +603,7 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                         with _phase("checkpoint", droot, "checkpoint"):
                             ckpt.save(it1, state, overwrite=True)
                         last_saved = it1
+                        _notify_checkpoint(on_checkpoint, it1, state, log_fn)
                     except Exception as e:
                         log_fn(f"periodic checkpoint at {it1} failed after "
                                f"retries ({type(e).__name__}: {e}); "
@@ -592,6 +613,7 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     if ckpt is not None:
         if not report.preempted and train_cfg.iters != last_saved:
             ckpt.save(train_cfg.iters, state, force=True, overwrite=True)
+            _notify_checkpoint(on_checkpoint, train_cfg.iters, state, log_fn)
         ckpt.close()
     _flush_losses()  # preempted/odd-tail runs: drain whatever is buffered
     report.steps = (last_it + 1 if report.preempted else train_cfg.iters) \
@@ -619,7 +641,8 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                       warmup_steps_excluded: int,
                       stats: Optional[ResilienceStats] = None,
                       telemetry=None, steps_per_dispatch: int = 1,
-                      window_shard_fn=None) -> LLMTrainReport:
+                      window_shard_fn=None,
+                      on_checkpoint=None) -> LLMTrainReport:
     """The chunked training loop (``_run_loop`` chunked mode) with a
     replica-loss recovery path threaded through it: every dispatch runs
     under a ``ReplicaLossError`` catch, every chunk edge feeds the
@@ -841,6 +864,7 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                     with _phase("checkpoint", droot, "checkpoint"):
                         ckpt.save(it1, state, overwrite=True)
                     last_saved = it1
+                    _notify_checkpoint(on_checkpoint, it1, state, log_fn)
                 except Exception as e:
                     log_fn(f"periodic checkpoint at {it1} failed after "
                            f"retries ({type(e).__name__}: {e}); "
@@ -851,6 +875,7 @@ def _run_elastic_loop(controller, step_fn, state, batches,
     if ckpt is not None:
         if not report.preempted and train_cfg.iters != last_saved:
             ckpt.save(train_cfg.iters, state, force=True, overwrite=True)
+            _notify_checkpoint(on_checkpoint, train_cfg.iters, state, log_fn)
         ckpt.close()
     _flush_losses()
     t_end = time.perf_counter()
@@ -918,7 +943,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  sink_every: int = 10,
                  resilience: Optional[ResilienceConfig] = None,
                  fault_plan=None,
-                 telemetry=None) -> LLMTrainReport:
+                 telemetry=None,
+                 on_checkpoint=None) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
     ``aggregation``: "gradient" (allreduce grads — intro_DP_GA), "weight"
@@ -978,6 +1004,13 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     surface: a manifest event with the step's static comm profile, per-step
     records + heartbeat from the loop, fault deltas, and a run_end metrics
     snapshot — render with ``python -m experiments.obs_report <dir>``.
+
+    ``on_checkpoint(step, state)`` is the checkpoint PUBLICATION hook —
+    the train→deploy seam (serving/deploy.py): called after every
+    successful periodic and final save (requires ``checkpoint_dir``), so
+    a ``CheckpointPublisher`` can stream params-only snapshots to a
+    serving fleet that hot-swaps them live. Guarded: a broken hook is
+    logged and skipped, never fatal.
     """
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
@@ -1222,7 +1255,7 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             sink_every=sink_every, log_every=log_every, log_fn=log_fn,
             warmup_steps_excluded=warmup_steps_excluded, stats=stats,
             telemetry=telemetry, steps_per_dispatch=spd,
-            window_shard_fn=window_shard)
+            window_shard_fn=window_shard, on_checkpoint=on_checkpoint)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     batches = _make_batches(n_data)
@@ -1238,7 +1271,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      window_shard_fn=lambda w: dp.shard_batch_window(mesh, w),
                      numerics=numerics,
                      numerics_every=train_cfg.numerics_every,
-                     compile_watch=compile_watch)
+                     compile_watch=compile_watch,
+                     on_checkpoint=on_checkpoint)
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,

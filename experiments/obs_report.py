@@ -125,35 +125,89 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                 for q in (50, 95, 99)) + f"  n={len(dts)} windows")
 
     if req_enq or req_pre or req_done or req_tok:
-        # Serving section (schema v2 request_* events, serving/scheduler.py).
-        # Runs with no serving events skip this silently — training and
-        # serving streams share one schema, not one workload.
+        # Serving section (schema v2 request_* events, serving/scheduler.py;
+        # schema v6 tags them per engine). Runs with no serving events skip
+        # this silently — training and serving streams share one schema,
+        # not one workload. Percentile tables group PER ENGINE: an
+        # N-engine fleet's streams must not pool into one table (each
+        # engine has its own pool, so "peak blocks in use" pooled across
+        # engines would compare apples to a sum of oranges), with the
+        # fleet-wide aggregate kept as the headline. Untagged (pre-v6 /
+        # single-engine) events group under one unlabeled engine, which
+        # renders exactly the old single-table output.
         _section("serving")
         print(f"requests: {len(req_enq)} enqueued   {len(req_pre)} admitted"
               f"   {len(req_done)} done   {len(req_tok)} token events")
-        waits = [e["queue_wait_s"] for e in req_done
-                 if isinstance(e.get("queue_wait_s"), (int, float))]
-        ttfts = [e["ttft_s"] for e in req_done
-                 if isinstance(e.get("ttft_s"), (int, float))]
-        for label, vals, unit in (("queue wait", waits, 1e3),
-                                  ("ttft", ttfts, 1e3)):
-            if vals:
-                print(f"{label}: " + "  ".join(
-                    f"p{q:g}={percentile(vals, q) * unit:.1f}ms"
-                    for q in (50, 95, 99)) + f"  n={len(vals)}")
+
+        def _latency_lines(done_events, indent=""):
+            waits = [e["queue_wait_s"] for e in done_events
+                     if isinstance(e.get("queue_wait_s"), (int, float))]
+            ttfts = [e["ttft_s"] for e in done_events
+                     if isinstance(e.get("ttft_s"), (int, float))]
+            for label, vals in (("queue wait", waits), ("ttft", ttfts)):
+                if vals:
+                    print(indent + f"{label}: " + "  ".join(
+                        f"p{q:g}={percentile(vals, q) * 1e3:.1f}ms"
+                        for q in (50, 95, 99)) + f"  n={len(vals)}")
+
+        _latency_lines(req_done)
         total_tokens = sum(e["tokens"] for e in req_done
                            if isinstance(e.get("tokens"), int))
         if req_done and req_pre:
             # Busy-span throughput from the stream's own timestamps:
-            # first admission -> last completion.
+            # first admission -> last completion (fleet-wide).
             span = max(e["t"] for e in req_done) - min(e["t"] for e in req_pre)
             if span > 0:
                 print(f"sustained: {total_tokens / span:,.1f} tok/s "
                       f"({total_tokens} tokens over {span:.2f}s busy span)")
-        blocks = [e["blocks_in_use"] for e in req_pre + req_done
-                  if isinstance(e.get("blocks_in_use"), int)]
-        if blocks:
-            print(f"peak blocks in use: {max(blocks)}")
+        engines = sorted({e.get("engine") for e in req_pre + req_done
+                          if e.get("engine") is not None})
+        if engines:
+            for eid in engines:
+                mine = [e for e in req_done if e.get("engine") == eid]
+                blocks = [e["blocks_in_use"] for e in req_pre + req_done
+                          if e.get("engine") == eid
+                          and isinstance(e.get("blocks_in_use"), int)]
+                print(f"engine {eid}: {len(mine)} done"
+                      + (f"   peak blocks in use {max(blocks)}"
+                         if blocks else ""))
+                _latency_lines(mine, indent="  ")
+        else:
+            blocks = [e["blocks_in_use"] for e in req_pre + req_done
+                      if isinstance(e.get("blocks_in_use"), int)]
+            if blocks:
+                print(f"peak blocks in use: {max(blocks)}")
+        tenants = sorted({e.get("tenant") for e in req_done
+                          if isinstance(e.get("tenant"), str)})
+        if len(tenants) > 1:
+            for cls in tenants:
+                mine = [e for e in req_done if e.get("tenant") == cls]
+                print(f"class {cls}: {len(mine)} done")
+                _latency_lines(mine, indent="  ")
+
+    routes = by_type.get("route", [])
+    deploys = by_type.get("deploy", [])
+    if routes or deploys:
+        # Fleet section (schema v6, serving/fleet.py + serving/deploy.py):
+        # router decisions and live weight rollouts.
+        _section("serving fleet (routing / deploys)")
+        if routes:
+            per_engine = {}
+            for e in routes:
+                per_engine[e.get("engine")] = \
+                    per_engine.get(e.get("engine"), 0) + 1
+            policy = next((e.get("policy") for e in routes
+                           if e.get("policy")), "?")
+            print(f"routed: {len(routes)} requests under {policy}   "
+                  + "  ".join(f"engine {k}: {v}"
+                              for k, v in sorted(per_engine.items(),
+                                                 key=lambda kv:
+                                                 str(kv[0]))))
+        for e in deploys:
+            print(f"  deploy version {e.get('version')} -> "
+                  f"engine {e.get('engine', '?')}  "
+                  f"({e.get('in_flight', 0)} in flight, "
+                  f"{e.get('queued', 0)} queued across the swap)")
 
     nums = by_type.get("numerics", [])
     if nums:

@@ -1,4 +1,4 @@
-"""Serving smoke + load bench: seeded Poisson traffic through the engine.
+"""Serving smoke + load bench: seeded Poisson traffic through the engine(s).
 
 The end-to-end proof of the serving subsystem (ddl25spring_tpu/serving) on
 the CPU mesh, CI-runnable (tier1.yml) — drives ~100 seeded Poisson
@@ -16,6 +16,19 @@ scheduler and CHECKS the acceptance bars itself:
   worst case), so admissions must queue under load — completing every
   request anyway is the no-deadlock evidence.
 
+``--engines N`` (N > 1) generalizes the smoke to the SERVING FLEET
+(serving/fleet.py): a two-class multi-tenant Poisson workload (priorities
++ per-class SLO targets) routed across N engines by the predicted-TTFT
+router, with ``--hot-swap`` driving one MID-RUN live weight publication
+through the full deploy path (params → publish-dir checkpoint →
+digest-verified restore-at-saved-shapes → staggered per-engine
+swap-at-token-boundary). Fleet-mode bars, on top of the single-engine
+ones (bitwise parity holds at ANY engine count — routing is a latency
+decision): every engine compiled exactly two programs with zero retraces
+ACROSS the hot-swap, the deploy rolled out to every engine, a ``deploy``
+span is present in the Perfetto export, and the per-class SLO verdict
+(slo_monitor's per-class rolling windows) replays clean.
+
 Outputs: a latency-percentile JSON (``--out``) and the request_* telemetry
 JSONL (``--telemetry-dir``, rendered by ``obs_report``); exit 1 on any
 failed check with the diagnostics in the JSON (tier1.yml uploads it either
@@ -24,6 +37,8 @@ way).
 Example:
     python -m experiments.serving_bench --out serving-latency.json \
         --telemetry-dir /tmp/serving
+    python -m experiments.serving_bench --engines 3 --hot-swap \
+        --out fleet-serving.json --telemetry-dir /tmp/fleet
     python -m experiments.obs_report /tmp/serving
 """
 
@@ -33,6 +48,35 @@ import argparse
 import json
 import sys
 import time
+
+
+def _stream_no_drop_no_dup(stream, workload) -> bool:
+    """The telemetry-path token contract, shared by both smokes: the
+    JSONL stream must carry every (request, index) exactly once."""
+    seen = {}
+    for e in stream:
+        if e.get("type") == "request_token":
+            seen.setdefault(e["req"], []).append(e["i"])
+    return all(sorted(seen.get(r.rid, [])) == list(range(r.max_new))
+               for r in workload)
+
+
+def _bitwise_sample(workload, recs, params, cfg, paged, *, seed, verify):
+    """Sampled bitwise parity vs generate() alone (each distinct request
+    shape costs one generate() compile), shared by both smokes. Returns
+    (sample_size, mismatched_rids)."""
+    import numpy as np
+
+    from ddl25spring_tpu.serving import reference_stream
+
+    rng = np.random.default_rng(seed + 1)
+    sample = (list(workload) if verify >= len(workload) else
+              [workload[i] for i in rng.choice(len(workload), verify,
+                                               replace=False)])
+    mismatches = [r.rid for r in sample
+                  if reference_stream(params, cfg, paged, r)
+                  != recs[r.rid].tokens]
+    return len(sample), mismatches
 
 
 def _build(seed: int):
@@ -55,8 +99,7 @@ def run(a) -> dict:
 
     from ddl25spring_tpu.serving import (PagedKVConfig, blocks_for,
                                          naive_cache_bytes, pool_bytes,
-                                         reference_stream, run_serving,
-                                         synthetic_workload)
+                                         run_serving, synthetic_workload)
     from ddl25spring_tpu.telemetry import Telemetry
     from ddl25spring_tpu.telemetry.events import read_events
 
@@ -105,13 +148,8 @@ def run(a) -> dict:
                            ("total_tokens", "sustained_tokens_per_sec")})
         tel.close()
         stream = read_events(tel.events_path)
-        seen = {}
-        for e in stream:
-            if e.get("type") == "request_token":
-                seen.setdefault(e["req"], []).append(e["i"])
-        checks["stream_no_drop_no_dup"] = all(
-            sorted(seen.get(r.rid, [])) == list(range(r.max_new))
-            for r in workload)
+        checks["stream_no_drop_no_dup"] = _stream_no_drop_no_dup(stream,
+                                                                 workload)
 
         # Span-tree completeness (ISSUE 8 acceptance bar): every request
         # reconstructs into ONE rooted tree with zero orphaned spans —
@@ -136,17 +174,9 @@ def run(a) -> dict:
             and sum(1 for ev in exported["traceEvents"]
                     if ev.get("ph") == "X") == n_spans > 0)
 
-    # Bitwise parity vs generate() alone, on a sampled subset (each
-    # distinct request shape costs one generate() compile).
-    import numpy as np
-    rng = np.random.default_rng(a.seed + 1)
-    sample = (list(workload) if a.verify >= len(workload) else
-              [workload[i] for i in rng.choice(len(workload), a.verify,
-                                               replace=False)])
-    mismatches = []
-    for r in sample:
-        if reference_stream(params, cfg, paged, r) != recs[r.rid].tokens:
-            mismatches.append(r.rid)
+    n_verified, mismatches = _bitwise_sample(workload, recs, params, cfg,
+                                             paged, seed=a.seed,
+                                             verify=a.verify)
     checks["bitwise_parity_vs_generate"] = not mismatches
 
     checks["pool_never_exceeded"] = (report.peak_blocks_in_use
@@ -182,10 +212,172 @@ def run(a) -> dict:
         "wall_s": round(wall, 3),
         "compiles": report.compiles,
         "retraces": report.retraces,
-        "verified_bitwise": len(sample),
+        "verified_bitwise": n_verified,
         "parity_mismatches": mismatches,
         "span_tree_problems": (tree_problems if events else None),
         "aggregates": report.aggregates,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    return out
+
+
+def run_fleet(a) -> dict:
+    """The N-engine fleet smoke (module docstring): multi-tenant traffic,
+    SLO-aware routing, one mid-run hot-swap through the deploy path."""
+    import os
+
+    import jax
+
+    from ddl25spring_tpu.serving import (CheckpointPublisher, TrafficClass,
+                                         WeightPublisher, blocks_for,
+                                         class_slos, multi_tenant_workload,
+                                         run_serving_fleet)
+    from ddl25spring_tpu.telemetry import Telemetry
+    from ddl25spring_tpu.telemetry.events import read_events
+    from experiments.slo_monitor import SLOConfig, replay_monitor
+
+    cfg, params = _build(a.seed)
+    from ddl25spring_tpu.serving import PagedKVConfig
+    paged = PagedKVConfig(num_blocks=a.blocks, block_len=a.block_len,
+                          max_blocks_per_seq=a.max_blocks_per_seq)
+    # Two tenant classes: latency-sensitive chat (higher priority, tight
+    # shapes) and throughput batch (longer outputs). SLO ceilings are
+    # deliberately generous — the verdict proves the per-class plumbing,
+    # not the latency of a noisy CI host paying XLA compiles.
+    classes = (
+        TrafficClass("chat", rate_rps=a.rate * 2 / 3, prompt_lens=(4, 12),
+                     max_news=(4, 8), temperatures=(0.0, 0.8), priority=1,
+                     ttft_p99_s=120.0, queue_p99_s=120.0),
+        TrafficClass("batch", rate_rps=a.rate / 3, prompt_lens=(12, 24),
+                     max_news=(8, 16), temperatures=(0.0,), priority=0,
+                     ttft_p99_s=240.0, queue_p99_s=240.0),
+    )
+    n_chat = (a.requests * 2) // 3
+    workload = multi_tenant_workload(
+        seed=a.seed, classes=classes,
+        n_per_class={"chat": n_chat, "batch": a.requests - n_chat},
+        vocab_size=cfg.vocab_size)
+
+    checks = {}
+    worst = blocks_for(24 + 16 - 1, a.block_len)
+    checks["pool_below_naive_demand"] = (paged.num_blocks - 1
+                                         < a.slots * worst)
+
+    tel = Telemetry(a.telemetry_dir) if a.telemetry_dir else None
+    events = tel.events if tel else None
+    if events:
+        events.manifest(jax_version=jax.__version__,
+                        platform=jax.default_backend(),
+                        trainer="serving-fleet", engines=a.engines,
+                        slots=a.slots, blocks=a.blocks,
+                        block_len=a.block_len, requests=len(workload),
+                        policy=a.policy, admission=a.admission)
+
+    # The mid-run publication, through the REAL deploy path: same weights
+    # (so the bitwise bar must hold across the swap), but routed via the
+    # publish-dir checkpoint, its SHA-256 digest manifest, and the
+    # restore-at-saved-shapes read — not an in-process pointer pass.
+    publish_after = publish_params = publish_version = None
+    if a.hot_swap:
+        import tempfile
+        pub_dir = os.path.join(a.telemetry_dir or tempfile.mkdtemp(),
+                               "publish")
+        pub = CheckpointPublisher(pub_dir)
+        pub(1200, params)               # "the trainer's step 1200"
+        pub.close()
+        got = WeightPublisher(pub_dir, params).poll()
+        checks["publish_roundtrip"] = got is not None
+        if got is not None:
+            publish_version, publish_params = got
+            publish_after = max(1, a.requests // 3)
+
+    t0 = time.perf_counter()
+    report = run_serving_fleet(
+        params, cfg, paged, workload, num_engines=a.engines,
+        num_slots=a.slots, prefill_chunk=a.prefill_chunk, events=events,
+        policy=a.policy, admission=a.admission,
+        publish_after=publish_after, publish_params=publish_params,
+        publish_version=publish_version)
+    wall = time.perf_counter() - t0
+
+    recs = report.records
+    checks["all_completed"] = (report.aggregates.get("completed")
+                               == len(workload))
+    checks["token_counts_exact"] = all(
+        len(recs[r.rid].tokens) == r.max_new for r in workload)
+    checks["engines_all_used"] = all(
+        agg["completed"] > 0 for agg in report.per_engine.values())
+    # Each engine: exactly two compiled programs, zero retraces — ACROSS
+    # the hot-swap (an equal-shape swap is data, never a shape).
+    checks["two_programs_per_engine"] = all(c == 2 for c in report.compiles)
+    checks["zero_retraces_per_engine"] = all(r == 0 for r in report.retraces)
+    if a.hot_swap:
+        checks["deploy_rolled_out_all_engines"] = (
+            sorted(d["engine"] for d in report.deploys)
+            == list(range(a.engines)))
+
+    slo = {}
+    if events:
+        events.run_end(steps=report.aggregates.get("completed", 0),
+                       wall_s=wall, **{
+                           k: report.aggregates.get(k) for k in
+                           ("total_tokens", "sustained_tokens_per_sec")})
+        tel.close()
+        stream = read_events(tel.events_path)
+        checks["stream_no_drop_no_dup"] = _stream_no_drop_no_dup(stream,
+                                                                 workload)
+        # Aggregate per-class SLO verdict: slo_monitor's per-class rolling
+        # windows replayed over this stream (the same tool tier1.yml runs
+        # as a CLI gate over the uploaded telemetry).
+        monitor = replay_monitor(
+            stream, SLOConfig(window_s=30.0, per_class=class_slos(classes)))
+        slo = {"violations": monitor.violations,
+               "breakdown": monitor.breakdown()}
+        checks["per_class_slo_ok"] = not monitor.violations
+        if a.hot_swap:
+            # The deploy must be VISIBLE evidence: one deploy event per
+            # engine in the stream, and a ``deploy`` span in the Perfetto
+            # export (the acceptance bar names the export specifically).
+            from experiments.trace_export import chrome_trace
+            deploy_events = [e for e in stream if e.get("type") == "deploy"]
+            checks["deploy_events_per_engine"] = (
+                sorted(e.get("engine") for e in deploy_events)
+                == list(range(a.engines)))
+            exported = json.loads(json.dumps(chrome_trace(stream)))
+            checks["deploy_span_in_perfetto_export"] = any(
+                ev.get("ph") == "X" and ev.get("name") == "deploy"
+                for ev in exported.get("traceEvents", []))
+
+    # Bitwise parity vs generate() alone — regardless of engine count,
+    # routing, priorities, or the mid-run same-weights hot-swap.
+    n_verified, mismatches = _bitwise_sample(workload, recs, params, cfg,
+                                             paged, seed=a.seed,
+                                             verify=a.verify)
+    checks["bitwise_parity_vs_generate"] = not mismatches
+
+    checks["pool_never_exceeded"] = all(
+        p <= report.pool_blocks for p in report.peak_blocks_per_engine)
+
+    out = {
+        "metric": "fleet_serving_smoke",
+        "engines": a.engines,
+        "policy": a.policy,
+        "admission": a.admission,
+        "requests": len(workload),
+        "hot_swap": bool(a.hot_swap),
+        "deploys": report.deploys,
+        "pool_blocks": report.pool_blocks,
+        "peak_blocks_per_engine": report.peak_blocks_per_engine,
+        "compiles": report.compiles,
+        "retraces": report.retraces,
+        "wall_s": round(wall, 3),
+        "verified_bitwise": n_verified,
+        "parity_mismatches": mismatches,
+        "aggregates": report.aggregates,
+        "per_class": report.per_class,
+        "per_engine": {str(k): v for k, v in report.per_engine.items()},
+        "slo": slo,
         "checks": checks,
         "ok": all(checks.values()),
     }
@@ -208,6 +400,18 @@ def main(argv=None) -> int:
                     help="requests to verify bitwise against generate()")
     ap.add_argument("--quick", action="store_true",
                     help="reduced request count (CI variance smoke)")
+    ap.add_argument("--engines", type=int, default=1,
+                    help="serving engines; > 1 runs the FLEET smoke "
+                         "(multi-tenant traffic, SLO-aware router)")
+    ap.add_argument("--policy", default="predicted_ttft",
+                    choices=("least_loaded", "predicted_ttft"),
+                    help="fleet router dispatch policy")
+    ap.add_argument("--admission", default="fcfs", choices=("fcfs", "sjf"),
+                    help="scheduler admission policy (fleet mode)")
+    ap.add_argument("--hot-swap", action="store_true",
+                    help="fleet mode: one mid-run live weight publication "
+                         "through the deploy path (same weights — the "
+                         "bitwise bar must hold across it)")
     ap.add_argument("--out", default=None, help="result JSON path")
     ap.add_argument("--telemetry-dir", default=None)
     a = ap.parse_args(argv)
@@ -215,7 +419,7 @@ def main(argv=None) -> int:
         a.requests = min(a.requests, 30)
         a.verify = min(a.verify, 6)
 
-    out = run(a)
+    out = run_fleet(a) if a.engines > 1 else run(a)
     line = json.dumps(out)
     if a.out:
         with open(a.out, "w") as f:

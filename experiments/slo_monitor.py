@@ -33,7 +33,16 @@ Objectives (each enabled by passing its threshold):
 - ``--slo-gradnorm``  grad-norm spike-rate ceiling: the fraction of the
   window's ``numerics`` samples whose global grad norm exceeds
   ``--gradnorm-factor`` × the window median (the drift signal that
-  precedes a StepGuard skip).
+  precedes a StepGuard skip);
+- ``--class-slo NAME:ttft_p99=S[,queue_p99=S]`` (repeatable) — PER-CLASS
+  objectives over the multi-tenant fleet's ``request_done`` events
+  (schema v6 ``tenant`` tags, serving/frontend.py TrafficClass):
+  each class gets its own rolling p99 windows, and a breach is reported
+  as ``<class>:ttft_p99_s`` so one tenant's misses never hide in a
+  fleet-wide percentile. The summary additionally carries a
+  ``breakdown`` of run-total per-class AND per-engine latency aggregates
+  (the ``engine`` tags the fleet scheduler stamps), so an N-engine
+  stream yields per-engine verdicts next to the aggregate one.
 
 Two modes:
 - **live** (default): follow the growing file (incremental reads, torn
@@ -152,6 +161,11 @@ class SLOConfig:
     min_mfu: Optional[float] = None
     max_gradnorm_spike_rate: Optional[float] = None
     gradnorm_spike_factor: float = 10.0
+    # Per-traffic-class objectives (schema v6 ``tenant`` tags):
+    # {class: {"ttft_p99_s": s, "queue_p99_s": s}} — the
+    # serving.frontend.class_slos shape. Violations are keyed
+    # "<class>:<objective>".
+    per_class: Optional[Dict[str, Dict[str, float]]] = None
 
 
 class SLOMonitor:
@@ -185,6 +199,14 @@ class SLOMonitor:
         self._gradnorms: deque = deque()  # (t, grad_norm)
         self._flops_per_step: Optional[float] = None
         self._peak_flops: Optional[float] = None
+        # Per-class rolling windows (one ttft + one wait deque per class
+        # with a configured SLO) and run-total per-class / per-engine
+        # accumulators for the summary breakdown — totals, not windows:
+        # the breakdown is a run verdict, the windows are the live alarm.
+        self._cls_ttft: Dict[str, deque] = {}
+        self._cls_wait: Dict[str, deque] = {}
+        self._by_class: Dict[str, dict] = {}
+        self._by_engine: Dict[Any, dict] = {}
         self.enqueued = 0
         self.done = 0
         self.run_ended = False
@@ -231,6 +253,7 @@ class SLOMonitor:
                     self._ttft.append((t, e["ttft_s"]))
                 if isinstance(e.get("queue_wait_s"), (int, float)):
                     self._wait.append((t, e["queue_wait_s"]))
+                self._feed_done_tags(t, e)
             elif etype == "fault":
                 counters = e.get("counters") or {}
                 skips = counters.get("skipped_steps", 0)
@@ -259,10 +282,61 @@ class SLOMonitor:
             elif etype == "run_end":
                 self.run_ended = True
 
+    # Per-(class/engine) breakdown samples kept per group: ``done`` counts
+    # stay exact, but the latency lists are bounded — the live monitor is
+    # a days-long sidecar, and unbounded per-request accumulation is
+    # exactly the leak this tool exists to catch in others. At the cap
+    # the percentiles become most-recent-window figures (still exact for
+    # CI-scale --check replays, which stay far below it).
+    BREAKDOWN_CAP = 10_000
+
+    def _feed_done_tags(self, t: float, e: Dict[str, Any]) -> None:
+        """Per-class windows (only classes with a configured SLO) and
+        run-total class/engine breakdown accumulators, from one
+        ``request_done``'s ``tenant``/``engine`` tags (schema v6)."""
+        ttft = e.get("ttft_s")
+        wait = e.get("queue_wait_s")
+        cls = e.get("tenant")
+        if isinstance(cls, str) and self.cfg.per_class \
+                and cls in self.cfg.per_class:
+            if isinstance(ttft, (int, float)):
+                self._cls_ttft.setdefault(cls, deque()).append((t, ttft))
+            if isinstance(wait, (int, float)):
+                self._cls_wait.setdefault(cls, deque()).append((t, wait))
+        for key, agg in ((cls, self._by_class),
+                         (e.get("engine"), self._by_engine)):
+            if key is None:
+                continue
+            rec = agg.setdefault(
+                key, {"done": 0, "ttft": deque(maxlen=self.BREAKDOWN_CAP),
+                      "wait": deque(maxlen=self.BREAKDOWN_CAP)})
+            rec["done"] += 1
+            if isinstance(ttft, (int, float)):
+                rec["ttft"].append(ttft)
+            if isinstance(wait, (int, float)):
+                rec["wait"].append(wait)
+
+    def breakdown(self) -> Dict[str, Any]:
+        """Run-total per-class and per-engine latency aggregates — the
+        summary's group-by view of the same stream the rolling windows
+        alarm on (keys stringified for JSON)."""
+        def agg(groups):
+            return {str(k): {
+                "done": rec["done"],
+                "ttft_p99_s": (percentile(rec["ttft"], 99)
+                               if rec["ttft"] else None),
+                "queue_p99_s": (percentile(rec["wait"], 99)
+                                if rec["wait"] else None),
+            } for k, rec in sorted(groups.items(), key=lambda kv:
+                                   str(kv[0]))}
+        return {"per_class": agg(self._by_class),
+                "per_engine": agg(self._by_engine)}
+
     def _prune(self, now: float) -> None:
         horizon = now - self.cfg.window_s
         for dq in (self._ttft, self._wait, self._tokens, self._skips,
-                   self._steps, self._dts, self._gradnorms):
+                   self._steps, self._dts, self._gradnorms,
+                   *self._cls_ttft.values(), *self._cls_wait.values()):
             while dq and dq[0][0] < horizon:
                 dq.popleft()
 
@@ -281,6 +355,18 @@ class SLOMonitor:
             v = percentile([x for _, x in self._wait], 99)
             if v > cfg.queue_p99_s:
                 measured["queue_p99_s"] = (v, cfg.queue_p99_s)
+        for cls, limits in (cfg.per_class or {}).items():
+            # Per-class windows: a quiet class has an empty window and no
+            # verdict (idle ≠ breached — same posture as the global
+            # objectives), a busy one is judged against ITS thresholds.
+            for slo, dq in (("ttft_p99_s", self._cls_ttft.get(cls)),
+                            ("queue_p99_s", self._cls_wait.get(cls))):
+                limit = limits.get(slo)
+                if limit is None or not dq:
+                    continue
+                v = percentile([x for _, x in dq], 99)
+                if v > limit:
+                    measured[f"{cls}:{slo}"] = (v, limit)
         if (cfg.min_tokens_per_sec is not None
                 and self.enqueued > self.done):
             # Outstanding work is what makes a low rate a STALL rather
@@ -361,12 +447,23 @@ class SLOMonitor:
 def check_stream(events: List[Dict[str, Any]], cfg: SLOConfig,
                  heartbeat: Optional[dict] = None,
                  emit: Optional[EventLog] = None) -> List[dict]:
-    """Offline replay for ``--check``: walk the stream in event time,
-    evaluating every quarter-window and once at the end — a stream that
-    goes SILENT mid-run (the stall case) is caught at that final
-    evaluation, whose ``now`` is the heartbeat's last beat when that is
-    newer than the last event (a dead writer's stream ends, its staleness
-    does not)."""
+    """Offline replay for ``--check``; returns the violation list (see
+    ``replay_monitor`` for the full monitor, breakdown included)."""
+    return replay_monitor(events, cfg, heartbeat=heartbeat,
+                          emit=emit).violations
+
+
+def replay_monitor(events: List[Dict[str, Any]], cfg: SLOConfig,
+                   heartbeat: Optional[dict] = None,
+                   emit: Optional[EventLog] = None) -> SLOMonitor:
+    """Offline replay: walk the stream in event time, evaluating every
+    quarter-window and once at the end — a stream that goes SILENT
+    mid-run (the stall case) is caught at that final evaluation, whose
+    ``now`` is the heartbeat's last beat when that is newer than the
+    last event (a dead writer's stream ends, its staleness does not).
+    Returns the monitor itself: ``violations`` for the verdict,
+    ``breakdown()`` for the per-class/per-engine group-by (the fleet
+    smoke consumes both)."""
     monitor = SLOMonitor(cfg, emit=emit)
     events = sorted(events, key=lambda e: e.get("t", 0.0))
     last_eval = None
@@ -386,7 +483,30 @@ def check_stream(events: List[Dict[str, Any]], cfg: SLOConfig,
                                                 (int, float)):
             end = max(end, heartbeat["time"])
         monitor.evaluate(end, heartbeat)
-    return monitor.violations
+    return monitor
+
+
+def parse_class_slo(specs) -> Optional[Dict[str, Dict[str, float]]]:
+    """``--class-slo`` values ("NAME:ttft_p99=S[,queue_p99=S]") into the
+    ``SLOConfig.per_class`` table."""
+    names = {"ttft_p99": "ttft_p99_s", "queue_p99": "queue_p99_s"}
+    per: Dict[str, Dict[str, float]] = {}
+    for spec in specs or []:
+        name, _, rest = spec.partition(":")
+        if not name or not rest:
+            raise ValueError(f"--class-slo {spec!r}: expected "
+                             "NAME:ttft_p99=S[,queue_p99=S]")
+        limits = {}
+        for part in rest.split(","):
+            k, _, v = part.partition("=")
+            key = names.get(k.strip())
+            if key is None or not v:
+                raise ValueError(f"--class-slo {spec!r}: unknown objective "
+                                 f"{k.strip()!r} (known: "
+                                 f"{', '.join(names)})")
+            limits[key] = float(v)
+        per[name] = limits
+    return per or None
 
 
 def main(argv=None) -> int:
@@ -420,6 +540,11 @@ def main(argv=None) -> int:
     ap.add_argument("--gradnorm-factor", type=float, default=10.0,
                     help="spike threshold multiple of the window-median "
                          "grad norm")
+    ap.add_argument("--class-slo", action="append", default=None,
+                    metavar="NAME:ttft_p99=S[,queue_p99=S]",
+                    help="per-traffic-class objectives (repeatable) over "
+                         "the fleet's tenant-tagged request_done events; "
+                         "violations key as '<class>:<objective>'")
     ap.add_argument("--poll", type=float, default=2.0,
                     help="live mode: seconds between evaluations")
     ap.add_argument("--duration", type=float, default=None,
@@ -440,6 +565,10 @@ def main(argv=None) -> int:
         events_path = a.path
         heartbeat_path = os.path.join(os.path.dirname(a.path) or ".",
                                       "heartbeat.json")
+    try:
+        per_class = parse_class_slo(a.class_slo)
+    except ValueError as e:
+        ap.error(str(e))
     cfg = SLOConfig(window_s=a.window, ttft_p99_s=a.ttft_p99,
                     queue_p99_s=a.queue_p99,
                     min_tokens_per_sec=a.min_tps,
@@ -447,7 +576,8 @@ def main(argv=None) -> int:
                     heartbeat_stale_s=a.heartbeat_stale,
                     min_mfu=a.slo_mfu,
                     max_gradnorm_spike_rate=a.slo_gradnorm,
-                    gradnorm_spike_factor=a.gradnorm_factor)
+                    gradnorm_spike_factor=a.gradnorm_factor,
+                    per_class=per_class)
     emit_default = not a.check
     emit = a.emit if a.emit is not None else emit_default
     # heal=False: we are a SIDECAR on a possibly-LIVE stream — append
@@ -481,7 +611,8 @@ def main(argv=None) -> int:
         if recorder is not None:
             for e in events:          # bundle context; never re-triggers
                 recorder.ingest(e)
-        violations = check_stream(events, cfg, heartbeat=_hb(), emit=log)
+        monitor = replay_monitor(events, cfg, heartbeat=_hb(), emit=log)
+        violations = monitor.violations
     else:
         tailer = StreamTailer(events_path)
         monitor = SLOMonitor(cfg, emit=log)
@@ -506,7 +637,11 @@ def main(argv=None) -> int:
         log.close()
 
     summary = {"events_path": events_path, "window_s": cfg.window_s,
-               "violations": violations, "ok": not violations}
+               "violations": violations, "ok": not violations,
+               # Per-class/per-engine group-by of the same stream —
+               # run totals, so an N-engine multi-tenant run reads as N+K
+               # verdicts instead of one pooled percentile table.
+               "breakdown": monitor.breakdown()}
     if a.out:
         with open(a.out, "w") as f:
             json.dump(summary, f)
