@@ -104,6 +104,21 @@ def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused",
                            overlap_microbatches=overlap_microbatches)
 
 
+def _hier_row_setup(dcn: int, wire, wire_dcn, n_dev: int):
+    """(mesh, per-axis wire dict) for a hierarchical sweep row — the ONE
+    eligibility rule both the child (--one) and the parent sweep apply:
+    n_dev must split into ``dcn`` islands of >= 2 replicas (a 1-replica
+    island has no ICI tier and the row would mislabel the flat ring).
+    Raises ValueError when ineligible; each call site picks its own
+    failure posture (child exits 3, parent skips the row)."""
+    if n_dev % dcn or n_dev < 2 * dcn:
+        raise ValueError(f"hier row needs n_dev divisible by dcn={dcn} "
+                         f"with >=2 per island (n_dev={n_dev})")
+    from ddl25spring_tpu.parallel.distributed import hier_data_mesh
+    return (hier_data_mesh(dcn, n_dev // dcn),
+            {"ici": wire or "fp32", "dcn": wire_dcn or "fp32"})
+
+
 def _time_batch_one(overrides_json: str, batch: str) -> None:
     """--one mode: time a single (variant, batch) point and print
     "<total_tokens_per_sec> <n_devices>".
@@ -125,6 +140,8 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
     spd = overrides.pop("_spd", 1)
     agg = overrides.pop("_agg", "gradient")
     ovl = overrides.pop("_ovl", 0)
+    dcn = overrides.pop("_dcn", 1)
+    wire_dcn = overrides.pop("_wire_dcn", None)
     if opt_name == "pallas":
         # Gate the '+padam' number on a real-lowering smoke: interpret-mode
         # CPU tests validate the math, not the Mosaic compile. A broken
@@ -133,7 +150,16 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
         smoke_check()
     cfg = dataclasses.replace(LlamaConfig(dtype="bfloat16"), **overrides)
     n_dev = len(jax.devices())
-    mesh = make_mesh({"data": n_dev})
+    if dcn > 1:
+        # Hierarchical row: dcn ICI islands bridged by DCN, two-level ring
+        # driver with the per-axis wire dict (parallel/compress.py).
+        try:
+            mesh, wire = _hier_row_setup(dcn, wire, wire_dcn, n_dev)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            sys.exit(3)
+    else:
+        mesh = make_mesh({"data": n_dev})
     print(time_batch(mesh, cfg, int(batch), opt_name=opt_name, wire=wire,
                      steps_per_dispatch=spd, aggregation=agg,
                      overlap_microbatches=ovl),
@@ -334,7 +360,17 @@ def main():
                          "flash-dhm+int8ring-z1k4", (64,)),
                         ({**flash_overrides, "_spd": 4, "_agg": "zero1",
                           "_wire": "int8_ef", "_ovl": 2},
-                         "flash-dhm+acco-m2", (64,))]
+                         "flash-dhm+acco-m2", (64,)),
+                        # Topology-aware two-level sync on the hybrid
+                        # mesh (hier_data_mesh): fp32 reduce-scatter
+                        # within each of 2 ICI islands, int8+EF across
+                        # the DCN axis only — DCN wire at ~1/S of the
+                        # vector × 1 byte/element, gated per-axis by
+                        # comm_wire_smoke; this row measures the
+                        # two-phase schedule's compute cost on-chip.
+                        ({**flash_overrides, "_spd": 4, "_agg": "zero1",
+                          "_wire_dcn": "int8_ef", "_dcn": 2, "_ovl": 1},
+                         "flash-dhm+hier-int8dcn-z1k4", (64,))]
         for overrides, label, batches in pallas_sweep:
             for bs in batches:
                 try:
@@ -385,7 +421,16 @@ def main():
                  # datum next to the multi-host wire design.
                  ({"dtype": "float32", "_spd": 8, "_agg": "zero1",
                    "_wire": "int8_ef", "_ovl": 1},
-                  "f32c+int8ring-z1k8", (8,))]
+                  "f32c+int8ring-z1k8", (8,)),
+                 # The two-level hierarchical driver end to end (fp32 ICI
+                 # ring + int8+EF DCN ring + compressed DCN delta gather
+                 # inside the K-step scan). Needs >= 2 devices for the
+                 # 2-island mesh — on the usual 1-device CPU fallback the
+                 # row reports "skipped" rather than faking a topology;
+                 # comm_wire_smoke carries the wire claim either way.
+                 ({"dtype": "float32", "_spd": 8, "_agg": "zero1",
+                   "_wire_dcn": "int8_ef", "_dcn": 2, "_ovl": 1},
+                  "f32c+hier-int8dcn-z1k8", (8,))]
     else:
         # bf16 scores: the documented XLA-path throughput knob.
         # attention_impl pinned to "xla": the config default ("auto") now
@@ -404,10 +449,19 @@ def main():
         agg = ov.pop("_agg", "gradient")
         wire = ov.pop("_wire", None)
         ovl = ov.pop("_ovl", 0)
+        dcn = ov.pop("_dcn", 1)
+        wire_dcn = ov.pop("_wire_dcn", None)
+        row_mesh = mesh
+        if dcn > 1:
+            try:
+                row_mesh, wire = _hier_row_setup(dcn, wire, wire_dcn, n_dev)
+            except ValueError as e:
+                print(f"variant {label}: skipped ({e})", file=sys.stderr)
+                continue
         cfg = dataclasses.replace(base, **ov)
         for bs in batches:
             try:
-                tps = time_batch(mesh, cfg, bs, steps_per_dispatch=spd,
+                tps = time_batch(row_mesh, cfg, bs, steps_per_dispatch=spd,
                                  aggregation=agg, wire=wire,
                                  overlap_microbatches=ovl)
             except Exception as e:  # one variant must not sink the sweep

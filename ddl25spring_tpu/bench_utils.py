@@ -72,7 +72,11 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
     (parallel/compress.py ``make_overlap_*``) instead — the path where
     ``wire`` (fp32/bf16/int8_ef in-flight ring chunks) composes with
     zero1 AND steps_per_dispatch; M = 0 keeps the legacy composition
-    rules, where ``wire`` needs per-step gradient aggregation."""
+    rules, where ``wire`` needs per-step gradient aggregation. On a
+    hierarchical mesh (hier_data_mesh), pass the per-axis dict
+    ``wire={"ici": ..., "dcn": ...}`` (requires M >= 1) — the two-level
+    topology-aware driver; ``dp.shard_batch``/``shard_batch_window``
+    place the batch over both data axes automatically."""
     seq = seq or cfg.ctx_size
     n_dev = mesh.devices.size
     K = max(1, int(steps_per_dispatch))

@@ -139,6 +139,14 @@ class TrainConfig:
     seed: int = 0
     # Mesh layout: named axis sizes. 1 disables that axis.
     data: int = 1
+    # Hierarchical data parallelism: dcn > 1 splits the DP world into
+    # ``dcn`` ICI islands of ``data`` replicas each (total world =
+    # dcn·data; parallel/distributed.py:hier_data_mesh). Gradient sync
+    # must then run the two-level ring driver (overlap_microbatches >= 1)
+    # with per-axis wire formats: ``wire`` is the ICI tier's format
+    # (fp32/bf16), ``wire_dcn`` the scarce DCN tier's (fp32/bf16/int8_ef)
+    # — compression spent exactly where bandwidth is scarce.
+    dcn: int = 1
     stage: int = 1                 # pipeline stages
     model: int = 1                 # tensor parallel degree
     seq: int = 1                   # sequence/context parallel degree
@@ -149,8 +157,17 @@ class TrainConfig:
     # (ops/mixed_precision.py — pair with LlamaConfig param_dtype bf16).
     optimizer: str = "adam"
     # Gradient-allreduce wire format for the DP trainer: "fp32" (plain
-    # pmean), "bf16" or "int8_ef" (parallel/compress.py).
+    # pmean), "bf16" or "int8_ef" (parallel/compress.py). On a
+    # hierarchical mesh (dcn > 1) this is the ICI tier's format and
+    # ``wire_dcn`` selects the DCN tier's.
     wire: str = "fp32"
+    # DCN-tier wire format of the two-level hierarchical collectives
+    # (requires dcn > 1 and overlap_microbatches >= 1): "" defaults to
+    # "fp32"; "int8_ef" is the headline mode — full-precision
+    # reduce-scatter within each ICI island, int8+error-feedback across
+    # the DCN hop only, intra-island gather after (the EQuARX/DynamiQ
+    # shape; parallel/compress.py hier_reduce_scatter).
+    wire_dcn: str = ""
     accum_steps: int = 1           # DP gradient accumulation (dp.py)
     # Fused multi-step dispatch (DP trainer): K > 1 lax.scans K training
     # steps over a [K, B, T] device-resident batch window in ONE compiled,
@@ -197,6 +214,16 @@ class ResilienceConfig:
     """
 
     guard: bool = True             # wrap the train step in a StepGuard
+    # In-jit non-finite skip fused INTO the compiled step (gradient/zero1
+    # and the overlap/ring drivers, parallel/{dp,compress}.py
+    # ``guard_nonfinite``): a bad step select-backs the whole state —
+    # EF residuals included — without leaving jit, the step counter does
+    # not advance, and the loop counts the non-advances into
+    # ``ResilienceStats.skipped_steps`` at the end-of-run sync. Mutually
+    # exclusive with ``guard`` (the host-side StepGuard would double-count
+    # the same skip; pick the sync-free fused skip OR the host guard's
+    # EMA/rollback machinery).
+    injit_guard: bool = False
     max_consecutive_bad: int = 3   # K consecutive bad steps → rollback
     ema_decay: float = 0.98        # update-norm EMA smoothing
     anomaly_factor: float = 10.0   # spike threshold (×EMA); <=0 disables

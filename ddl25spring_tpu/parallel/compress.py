@@ -188,6 +188,16 @@ def make_int8_ef_grad_step(loss_fn: Callable,
 # hoped-for from the XLA scheduler. Pattern references (PAPERS.md):
 # accumulate-while-you-communicate (ACCO, arxiv 2406.02613) and quantized
 # in-flight collectives (EQuARX, arxiv 2506.17615; DynamiQ, 2602.08923).
+#
+# On a HIERARCHICAL mesh (parallel/distributed.py:hier_data_mesh — fast
+# ICI islands bridged by slow DCN) the same drivers take a PER-AXIS wire
+# format (wire={"ici": ..., "dcn": ...}) and run the TWO-LEVEL reduction
+# (``hier_reduce_scatter``): full-precision ring within each island, the
+# compressed ring across the DCN axis only, compressed DCN broadcast +
+# intra-island gather on the way back — wire compression spent exactly
+# where bandwidth is scarce (the EQuARX/DynamiQ topology-aware shape),
+# with every hop's bytes attributed to its mesh axis in the telemetry
+# comm profile (CommProfile.by_axis — the CI-gated DCN budget).
 
 
 def _int8_encode(c):
@@ -298,22 +308,84 @@ def ring_reduce_scatter(x, axis_name: str, *, wire: str = "fp32",
     return partial, residual
 
 
+def hier_reduce_scatter(x, *, wire_ici: str = "fp32",
+                        wire_dcn: str = "int8_ef", residual=None,
+                        ici_axis: str = "data", dcn_axis: str = "dcn",
+                        label: str = "ring_grad", comm_scale: int = 1):
+    """Two-level reduce-scatter on the hierarchical (dcn × data) mesh
+    (parallel/distributed.py:hier_data_mesh): a full-precision ring
+    reduce-scatter WITHIN each ICI island (the fast tier — ``wire_ici`` ∈
+    {fp32, bf16}), then a second ring across the ``dcn`` axis only (the
+    scarce tier — ``wire_dcn`` ∈ {fp32, bf16, int8_ef}), so compressed
+    wire formats are spent exactly on the hops where bandwidth is scarce
+    (EQuARX / DynamiQ, PAPERS.md). Must run inside ``shard_map`` over both
+    axes.
+
+    ``x``: ``[n·chunk]`` fp32 local contribution with n = D·S (D =
+    islands, S = island size). Phase 1 scatters S superchunks of D·chunk
+    over the island (each a contiguous ``(S−1)``-hop ICI ring of
+    ``ring_reduce_scatter``'s documented order); phase 2 scatters each
+    superchunk's D chunks across islands ((D−1) DCN hops of chunk bytes —
+    1/S of the vector ever crosses DCN, and S parallel DCN rings carry
+    it). Shard (d, s) ends up owning chunk ``s·D + d`` of the cross-shard
+    SUM — the ``dp.slice_index`` ownership map, shared with the ZeRO-1
+    update so the reduced chunk lands on the shard that owns its slice.
+
+    ``residual`` threads the DCN ring's int8 error-feedback state (flat
+    ``[D·chunk]``, per (shard, dcn-chunk) — the ICI tier is full
+    precision and carries none); pass None for fp32/bf16 DCN wire.
+
+    Summation-order spec (pinned in tests/test_hier_collectives.py):
+    chunk ``s·D + d`` associates as the DCN-ring-order chain over island
+    partials, each island partial itself the ICI-ring-order chain of its
+    members — a chain of chains. At D = 1 or S = 1 this IS the flat
+    ring's single chain (bitwise — one of the two rings degenerates to
+    the identity); at other factorizations it re-associates the same sum,
+    so flat-vs-two-level equality is bitwise exactly where the addition
+    is exact (integer-valued gradients — the ``ring_reduce_scatter`` vs
+    ``psum_scatter`` contract) and re-association-close on general
+    floats.
+
+    Telemetry: every hop records through ``comm.ppermute`` with its OWN
+    axis name, so the comm profile attributes ICI and DCN bytes
+    separately (``CommProfile.by_axis``) — per device: (S−1)·(D·chunk)
+    bytes on the ICI axis, (D−1)·chunk bytes (in the DCN wire format) on
+    the DCN axis, per call.
+    """
+    if wire_ici not in ("fp32", "bf16"):
+        raise ValueError(
+            "the ICI tier is the full-precision tier: wire_ici must be "
+            f"'fp32' or 'bf16' (got {wire_ici!r}) — int8+EF belongs on "
+            "the scarce DCN axis")
+    superchunk, _ = ring_reduce_scatter(
+        x, ici_axis, wire=wire_ici, residual=None,
+        label=f"{label}_ici", comm_scale=comm_scale)
+    return ring_reduce_scatter(
+        superchunk, dcn_axis, wire=wire_dcn, residual=residual,
+        label=f"{label}_dcn", comm_scale=comm_scale)
+
+
 class OverlapEFState(NamedTuple):
     """TrainState + the two error-feedback residual trees of the int8 ring
-    driver, both sharded over ``data`` and zero at init:
+    driver, both sharded over the data-parallel world and zero at init:
 
-    - ``ring_residual`` [n, Ppad] (per-shard slice [1, Ppad]): chunk-indexed
-      per-hop quantization error of the gradient ring — shard r's slot c is
-      the error of the partial r last sent for chunk c (r's own chunk slot
-      stays 0: the owner's contribution is added in fp32).
+    - ``ring_residual`` [n, ring_len] (per-shard slice [1, ring_len]):
+      chunk-indexed per-hop quantization error of the int8 gradient ring —
+      shard r's slot c is the error of the partial r last sent for chunk c
+      (r's own chunk slot stays 0: the owner's contribution is added in
+      fp32). Flat driver: ring_len = Ppad (the n-chunk data ring).
+      Hierarchical driver: ring_len = D·local (only the DCN ring carries
+      EF state — the ICI tier is full precision).
     - ``gather_residual`` [Ppad] (per-shard slice [local]): error of the
       second-leg quantization — the param-delta broadcast (zero1) or the
-      reduced-grad-slice broadcast (gradient aggregation).
+      reduced-grad-slice broadcast (gradient aggregation); hierarchically,
+      the broadcast's DCN leg.
 
     Both ride the scan carry of the K-step driver and the checkpointed
     state tree, so the accumulated quantization error survives
     ``make_overlap_multi_step`` composition, chunk-edge checkpoints and a
-    preempt/resume cycle exactly (pinned in tests/test_compress.py)."""
+    preempt/resume cycle exactly (pinned in tests/test_compress.py and
+    tests/test_hier_collectives.py)."""
     params: Any
     opt_state: Any
     step: jnp.ndarray
@@ -321,43 +393,81 @@ class OverlapEFState(NamedTuple):
     gather_residual: Any
 
 
-def _overlap_setup(mesh: Mesh, params, optimizer, wire: str,
-                   aggregation: str):
+def _overlap_setup(mesh: Mesh, params, optimizer, wire, aggregation: str):
     """State + shard specs + flat geometry for the overlap driver. The
     zero1 variant reuses ``dp._zero1_setup`` wholesale, so the slice the
-    ring chunk lands on IS the slice the sharded update owns."""
-    from .dp import _flat_geometry, _zero1_setup
+    ring chunk lands on IS the slice the sharded update owns (including
+    the hierarchical ``dp.slice_index`` map).
+
+    ``wire``: a format string for the flat data ring, or the per-axis dict
+    ``{"ici": ..., "dcn": ...}`` selecting the two-level path on a
+    hierarchical mesh. Returns ``(state, specs, dpart, n, pad, local,
+    total, hier_shape)`` — ``dpart`` the normalized data PartitionSpec
+    entry (dp.data_partition), ``hier_shape`` = ``(D, S)`` for the
+    two-level path, None for the flat ring."""
+    from .dp import _flat_geometry, _zero1_setup, data_partition
 
     if aggregation not in ("gradient", "zero1"):
         raise ValueError("overlap driver supports gradient/zero1 "
                          f"aggregation only (got {aggregation!r})")
-    if wire not in ("fp32", "bf16", "int8_ef"):
-        raise ValueError(f"unknown wire format {wire!r}")
+    if isinstance(wire, dict):
+        if set(wire) != {"ici", "dcn"}:
+            raise ValueError("per-axis wire must be "
+                             '{"ici": fmt, "dcn": fmt} '
+                             f"(got keys {sorted(wire)})")
+        if "dcn" not in mesh.shape:
+            raise ValueError(
+                "per-axis wire formats need a hierarchical mesh with a "
+                "'dcn' axis (parallel/distributed.py:hier_data_mesh)")
+        if wire["ici"] not in ("fp32", "bf16"):
+            raise ValueError(
+                "the ICI tier is the full-precision tier: wire['ici'] "
+                f"must be 'fp32' or 'bf16' (got {wire['ici']!r}) — "
+                "int8+EF belongs on the scarce DCN axis")
+        if wire["dcn"] not in ("fp32", "bf16", "int8_ef"):
+            raise ValueError(f"unknown DCN wire format {wire['dcn']!r}")
+        hier_shape = (mesh.shape["dcn"], mesh.shape["data"])
+        ef = wire["dcn"] == "int8_ef"
+    else:
+        if wire not in ("fp32", "bf16", "int8_ef"):
+            raise ValueError(f"unknown wire format {wire!r}")
+        if mesh.shape.get("dcn", 1) > 1:
+            raise ValueError(
+                "a hierarchical (dcn x data) mesh needs the per-axis wire "
+                'dict ({"ici": ..., "dcn": ...}) — a flat wire string '
+                "would run the ring over the 'data' axis only and never "
+                "cross DCN")
+        hier_shape = None
+        ef = wire == "int8_ef"
+    dpart = data_partition(mesh)
     n, pad, local, total = _flat_geometry(mesh, params)
     if aggregation == "zero1":
         base, opt_specs, *_ = _zero1_setup(optimizer, mesh, params)
     else:
         base = replicate(mesh, init_state(params, optimizer))
         opt_specs = P()
-    if wire == "int8_ef":
-        ppad = n * local
-        ring_res = jax.device_put(jnp.zeros((n, ppad), jnp.float32),
-                                  NamedSharding(mesh, P("data")))
-        gather_res = jax.device_put(jnp.zeros((ppad,), jnp.float32),
-                                    NamedSharding(mesh, P("data")))
+    if ef:
+        ring_len = (hier_shape[0] if hier_shape is not None else n) * local
+        dshard = P(dpart)
+        ring_res = jax.device_put(jnp.zeros((n, ring_len), jnp.float32),
+                                  NamedSharding(mesh, dshard))
+        gather_res = jax.device_put(jnp.zeros((n * local,), jnp.float32),
+                                    NamedSharding(mesh, dshard))
         state = OverlapEFState(base.params, base.opt_state, base.step,
                                ring_res, gather_res)
-        specs = OverlapEFState(P(), opt_specs, P(), P("data"), P("data"))
+        specs = OverlapEFState(P(), opt_specs, P(), dshard, dshard)
     else:
         state = base
         specs = TrainState(P(), opt_specs, P())
-    return state, specs, n, pad, local, total
+    return state, specs, dpart, n, pad, local, total, hier_shape
 
 
 def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                              local: int, total: int, *, microbatches: int,
-                             wire: str, aggregation: str,
-                             comm_scale: int = 1) -> Callable:
+                             wire, aggregation: str,
+                             comm_scale: int = 1, hier_shape=None,
+                             guard_nonfinite: bool = False,
+                             numerics=None) -> Callable:
     """The per-shard overlapped step body shared by ``make_overlap_step``
     and ``make_overlap_multi_step`` — one implementation, so per-step and
     K-scanned dispatch cannot drift (their bitwise equality at any K is the
@@ -371,15 +481,60 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
     fed to the ZeRO-1 sliced update + (compressed) param gather, or
     all-gathered (in the wire format) for the replicated update.
 
+    ``hier_shape`` = (D, S) selects the two-level topology: the reduce is
+    ``hier_reduce_scatter`` (full-precision ICI ring within each island,
+    ``wire["dcn"]`` ring across islands), slice ownership is
+    ``dp.slice_index``'s s·D + d map, and the broadcast leg runs its DCN
+    hop first (compressed when ``wire["dcn"] = "int8_ef"``: the quantized
+    delta/grad payload crosses DCN once at one byte/element) and the
+    intra-island gather second — only 1/S of the vector ever crosses the
+    DCN axis, the telemetry-visible budget the smoke gates. bf16 on the
+    ICI tier compresses the ring's in-flight partials (and the replicated
+    path's grad gather); the zero1 param gather stays fp32 on both legs
+    except the int8 DCN delta, mirroring the flat driver's
+    params-stay-exact rule.
+
+    ``guard_nonfinite`` fuses the in-jit skip: the finiteness verdict on
+    (loss, owned gradient slice) is psum-agreed across every data axis —
+    per-shard slices can disagree, and replicas applying different
+    verdicts would silently diverge — and a bad step select-backs the
+    WHOLE incoming state (params, moments, both EF residual trees) without
+    leaving jit; ``step`` does not advance, which is how the host counts
+    skips into ResilienceStats (train/llm.py). The returned loss stays the
+    non-finite one, so host-side guards/telemetry still see the fault.
+
+    ``numerics`` (telemetry.introspect.NumericsHandle, built with
+    ``psum_axis`` = the data axes): the step's second output becomes
+    ``(loss, NumericsSummary)`` — grad stats over the local microbatch-mean
+    gradient (psum-agreed by the summarizer), update stats over the
+    ATTEMPTED update — computed from values the step already holds, so
+    losses/params are bitwise identical on vs off (pinned).
+
     Numerics contract: microbatch gradients are REDUCED per microbatch and
     summed on the owner (reduce-then-accumulate), whereas ``accum_steps``
     accumulates locally then reduces once — same math, different float
     association, so M>1 matches the monolithic paths to fp32 tolerance,
     not bitwise (M=1 differs from them only by the ring-vs-linear
-    reduction order; see ``ring_reduce_scatter``). The int8 gather leg
-    broadcasts one quantized payload that every shard applies identically,
-    so replicas stay bitwise in sync in every mode."""
+    reduction order; see ``ring_reduce_scatter``). The compressed gather
+    legs broadcast one payload that every shard applies identically, so
+    replicas stay bitwise in sync in every mode and topology."""
     M = microbatches
+    hier = hier_shape is not None
+    if hier:
+        D, S = hier_shape
+        wire_ici, wire_dcn = wire["ici"], wire["dcn"]
+        ef = wire_dcn == "int8_ef"
+    else:
+        ef = wire == "int8_ef"
+
+    def _reduce(pending, ring_res):
+        if hier:
+            return hier_reduce_scatter(
+                pending, wire_ici=wire_ici, wire_dcn=wire_dcn,
+                residual=ring_res, comm_scale=comm_scale)
+        return ring_reduce_scatter(pending, "data", wire=wire,
+                                   residual=ring_res,
+                                   comm_scale=comm_scale)
 
     def local_step(state, batch):
         from ..utils import pytree as pt
@@ -388,40 +543,82 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
             raise ValueError(f"local batch {batch.shape[0]} not divisible "
                              f"by overlap_microbatches={M}")
         params = state.params
-        ring_res = (state.ring_residual[0] if wire == "int8_ef" else None)
+        ring_res = (state.ring_residual[0] if ef else None)
         micro = batch.reshape((M, -1) + batch.shape[1:])
         acc = jnp.zeros((local,), jnp.float32)
         loss_sum = jnp.zeros((), jnp.float32)
+        gacc = None
         pending = None
         for m in range(M):
             l, g = jax.value_and_grad(loss_fn)(params, micro[m])
             loss_sum = loss_sum + l.astype(jnp.float32)
+            if numerics is not None:
+                # Extra OUTPUT only: the fp32 grad accumulator feeds the
+                # summary, never the ring — losses/params bitwise on/off.
+                gacc = (jax.tree.map(lambda x: x.astype(jnp.float32), g)
+                        if gacc is None else
+                        jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
+                                     gacc, g))
             if pending is not None:
                 # Microbatch m−1's ring rides alongside microbatch m's
                 # grad compute (the lines above): independent dataflow.
-                red, ring_res = ring_reduce_scatter(
-                    pending, "data", wire=wire, residual=ring_res,
-                    comm_scale=comm_scale)
+                red, ring_res = _reduce(pending, ring_res)
                 acc = acc + red
             pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
                               (0, pad))
-        red, ring_res = ring_reduce_scatter(
-            pending, "data", wire=wire, residual=ring_res,
-            comm_scale=comm_scale)
+        red, ring_res = _reduce(pending, ring_res)
         acc = acc + red
         g_mine = acc / (n * M)      # mean over shards and microbatches
         loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
                           scale=comm_scale)
+        if hier:
+            # Mean of equal-size island means == the global mean; the DCN
+            # leg of the loss reduction is 4 bytes, attributed to its axis.
+            loss = comm.pmean(loss, "dcn", label="loss_allreduce_dcn",
+                              scale=comm_scale)
 
         raw_flat, unravel = pt.flatten(params)
         flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
         gather_res = None
         if aggregation == "zero1":
-            shard = lax.axis_index("data")
+            if hier:
+                from .dp import hier_slice_index
+                shard = hier_slice_index(D)
+            else:
+                shard = lax.axis_index("data")
             p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
             new_p_mine, opt_state = apply_optimizer(
                 optimizer, g_mine, state.opt_state, p_mine)
-            if wire == "int8_ef":
+            if hier:
+                # Two-level broadcast, DCN leg first: islands exchange
+                # their superchunk's D slices (compressed when the DCN
+                # wire says so), then the island gathers S superchunks
+                # over ICI in fp32 — params stay exact on the fast tier.
+                if wire_dcn == "int8_ef":
+                    q, s, gather_res = _int8_encode(
+                        (new_p_mine - p_mine) + state.gather_residual)
+                    q_all = comm.all_gather(
+                        q, "dcn", tiled=True,
+                        label="overlap_delta_gather_int8",
+                        scale=comm_scale)
+                    s_all = comm.all_gather(
+                        s[None], "dcn", tiled=True,
+                        label="overlap_delta_scale_gather",
+                        scale=comm_scale)
+                    p_super = lax.dynamic_slice_in_dim(
+                        flat_p, lax.axis_index("data") * (D * local),
+                        D * local)
+                    super_new = p_super + (jnp.repeat(s_all, local)
+                                           * q_all.astype(jnp.float32))
+                else:
+                    super_new = comm.all_gather(
+                        new_p_mine, "dcn", tiled=True,
+                        label="overlap_param_gather_dcn",
+                        scale=comm_scale)
+                flat_new = comm.all_gather(
+                    super_new, "data", tiled=True,
+                    label="overlap_param_gather_ici", scale=comm_scale)
+            elif wire == "int8_ef":
                 # Compressed second leg: broadcast the param DELTA int8
                 # (one byte/element + one scale/shard) with its own EF
                 # residual at the owner. Every shard — the owner included —
@@ -444,7 +641,41 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                                            scale=comm_scale)
             new_params = unravel(flat_new[:total].astype(raw_flat.dtype))
         else:                       # replicated update
-            if wire == "int8_ef":
+            if hier:
+                if wire_dcn == "int8_ef":
+                    q, s, gather_res = _int8_encode(
+                        g_mine + state.gather_residual)
+                    q_all = comm.all_gather(
+                        q, "dcn", tiled=True,
+                        label="overlap_grad_gather_int8",
+                        scale=comm_scale)
+                    s_all = comm.all_gather(
+                        s[None], "dcn", tiled=True,
+                        label="overlap_grad_scale_gather",
+                        scale=comm_scale)
+                    super_g = (jnp.repeat(s_all, local)
+                               * q_all.astype(jnp.float32))
+                elif wire_dcn == "bf16":
+                    super_g = comm.all_gather(
+                        g_mine.astype(jnp.bfloat16), "dcn", tiled=True,
+                        label="overlap_grad_gather_dcn_bf16",
+                        scale=comm_scale).astype(jnp.float32)
+                else:
+                    super_g = comm.all_gather(
+                        g_mine, "dcn", tiled=True,
+                        label="overlap_grad_gather_dcn",
+                        scale=comm_scale)
+                if wire_ici == "bf16":
+                    flat_g = comm.all_gather(
+                        super_g.astype(jnp.bfloat16), "data", tiled=True,
+                        label="overlap_grad_gather_ici_bf16",
+                        scale=comm_scale).astype(jnp.float32)
+                else:
+                    flat_g = comm.all_gather(
+                        super_g, "data", tiled=True,
+                        label="overlap_grad_gather_ici",
+                        scale=comm_scale)
+            elif wire == "int8_ef":
                 q, s, gather_res = _int8_encode(
                     g_mine + state.gather_residual)
                 q_all = comm.all_gather(q, "data", tiled=True,
@@ -467,13 +698,44 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
             grads = unravel(flat_g[:total].astype(raw_flat.dtype))
             new_params, opt_state = apply_optimizer(
                 optimizer, grads, state.opt_state, params)
+        summary = None
+        if numerics is not None:
+            # Grad stats: local microbatch-mean gradient (the summarizer
+            # psum-agrees them over the data axes); update stats: the
+            # ATTEMPTED update — under guard_nonfinite a skipped step
+            # still reports the norms of the update it refused, the
+            # attribution a postmortem needs.
+            summary = numerics.summarize(
+                params, jax.tree.map(lambda x: x / M, gacc), new_params)
         step = state.step + 1
-        if wire == "int8_ef":
+        if ef:
             new_state = OverlapEFState(new_params, opt_state, step,
                                        ring_res[None], gather_res)
         else:
             new_state = TrainState(new_params, opt_state, step)
-        return new_state, loss
+        if guard_nonfinite:
+            # Per-shard verdicts CAN disagree (each shard owns a different
+            # slice of the reduced gradient), so the skip must be
+            # psum-agreed before anyone applies state — the zero1 guard's
+            # rule, extended over both axes of the hierarchical mesh.
+            ok = jnp.isfinite(loss) & jnp.all(jnp.isfinite(g_mine))
+            oki = comm.psum(ok.astype(jnp.int32), "data",
+                            label="overlap_guard_verdict",
+                            scale=comm_scale)
+            if hier:
+                oki = comm.psum(oki, "dcn",
+                                label="overlap_guard_verdict_dcn",
+                                scale=comm_scale)
+            ok = oki == n
+            # Select-back the WHOLE state (EF residuals included): a
+            # skipped step is a true no-op, and the residuals must not
+            # absorb a rejected step's quantization error.
+            new_state = jax.tree.map(lambda a, b: jnp.where(ok, a, b),
+                                     new_state, state)
+            new_state = new_state._replace(
+                step=state.step + ok.astype(state.step.dtype))
+        return new_state, ((loss, summary) if summary is not None
+                           else loss)
 
     return local_step
 
@@ -481,22 +743,32 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
 def make_overlap_step(loss_fn: Callable,
                       optimizer: optax.GradientTransformation,
                       mesh: Mesh, params, *, microbatches: int = 1,
-                      wire: str = "fp32",
-                      aggregation: str = "gradient"):
+                      wire="fp32", aggregation: str = "gradient",
+                      guard_nonfinite: bool = False, numerics=None):
     """Per-step overlapped+compressed gradient-sync driver: ``step(state,
-    batch) -> (state, loss)`` over a ``[B, T]`` batch sharded over
-    ``data``. Returns ``(state, step_fn)``; the state is an
-    ``OverlapEFState`` for ``wire="int8_ef"`` (EF residuals in the tree),
-    a plain TrainState otherwise — with ZeRO-1-sharded moments when
-    ``aggregation="zero1"``. Semantics in ``_make_overlap_local_step``."""
-    state, specs, n, pad, local, total = _overlap_setup(
+    batch) -> (state, loss)`` over a ``[B, T]`` batch sharded over the
+    data-parallel world. Returns ``(state, step_fn)``; the state is an
+    ``OverlapEFState`` when any tier runs ``int8_ef`` (EF residuals in the
+    tree), a plain TrainState otherwise — with ZeRO-1-sharded moments when
+    ``aggregation="zero1"``.
+
+    ``wire``: a format string runs the flat data-axis ring (PR 10); the
+    per-axis dict ``{"ici": "fp32"|"bf16", "dcn":
+    "fp32"|"bf16"|"int8_ef"}`` runs the TWO-LEVEL reduction on a
+    hierarchical mesh (``hier_data_mesh``): full-precision reduce-scatter
+    within each ICI island, the compressed exchange across the DCN axis
+    only, then the intra-island gather. ``guard_nonfinite`` fuses the
+    psum-agreed in-jit skip; ``numerics`` turns on the in-jit run-health
+    summary. Semantics in ``_make_overlap_local_step``."""
+    state, specs, dpart, n, pad, local, total, hier_shape = _overlap_setup(
         mesh, params, optimizer, wire, aggregation)
     local_step = _make_overlap_local_step(
         loss_fn, optimizer, n, pad, local, total, microbatches=microbatches,
-        wire=wire, aggregation=aggregation)
+        wire=wire, aggregation=aggregation, hier_shape=hier_shape,
+        guard_nonfinite=guard_nonfinite, numerics=numerics)
     sharded = shard_map(
         local_step, mesh=mesh,
-        in_specs=(specs, P("data")), out_specs=(specs, P()),
+        in_specs=(specs, P(dpart)), out_specs=(specs, P()),
         check_vma=False)
     return state, jax.jit(sharded, donate_argnums=(0,))
 
@@ -504,8 +776,8 @@ def make_overlap_step(loss_fn: Callable,
 def make_overlap_multi_step(loss_fn: Callable,
                             optimizer: optax.GradientTransformation,
                             mesh: Mesh, params, *, microbatches: int = 1,
-                            wire: str = "fp32",
-                            aggregation: str = "gradient"):
+                            wire="fp32", aggregation: str = "gradient",
+                            guard_nonfinite: bool = False, numerics=None):
     """The overlapped+compressed driver inside the K-step scan:
     ``step(state, window) -> (state, losses)`` with ``window`` a
     ``[K, n_shards·B, T]`` batch window (``dp.shard_batch_window``) run in
@@ -514,19 +786,24 @@ def make_overlap_multi_step(loss_fn: Callable,
     bitwise-identical to K per-step calls at any K and M (pinned in
     tests/test_compress.py) — and the int8 EF residuals ride the scan
     carry, so error feedback is exact across fused steps and chunk-edge
-    checkpoints."""
-    state, specs, n, pad, local, total = _overlap_setup(
+    checkpoints. ``wire`` accepts the same per-axis dict as
+    ``make_overlap_step`` for the two-level hierarchical path, and
+    ``guard_nonfinite``/``numerics`` ride the scanned body unchanged (the
+    numerics summary comes back stacked [K], exactly like
+    ``dp.make_multi_step``'s)."""
+    state, specs, dpart, n, pad, local, total, hier_shape = _overlap_setup(
         mesh, params, optimizer, wire, aggregation)
 
     def multi(state, window):
         local_step = _make_overlap_local_step(
             loss_fn, optimizer, n, pad, local, total,
             microbatches=microbatches, wire=wire, aggregation=aggregation,
-            comm_scale=window.shape[0])
+            comm_scale=window.shape[0], hier_shape=hier_shape,
+            guard_nonfinite=guard_nonfinite, numerics=numerics)
         return lax.scan(local_step, state, window)
 
     sharded = shard_map(
         multi, mesh=mesh,
-        in_specs=(specs, P(None, "data")), out_specs=(specs, P()),
+        in_specs=(specs, P(None, dpart)), out_specs=(specs, P()),
         check_vma=False)
     return state, jax.jit(sharded, donate_argnums=(0,))

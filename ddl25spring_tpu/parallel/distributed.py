@@ -115,6 +115,25 @@ def hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     return Mesh(dev_array, tuple(names[i] for i in order))
 
 
+def hier_data_mesh(islands: int, island_size: int, *,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """Two-tier DATA-parallel mesh: ``islands`` ICI islands of
+    ``island_size`` replicas each, bridged by DCN — axes ``("dcn",
+    "data")`` with island-major device order (replica (d, s) = device
+    d·island_size + s). This is the substrate of the hierarchical
+    collectives (parallel/compress.py): full-precision reduction inside
+    each island's ``data`` axis, a compressed exchange across ``dcn``
+    only — wire compression spent exactly where bandwidth is scarce.
+
+    Multi-host: delegates to ``hybrid_mesh`` so the ``dcn`` axis really
+    spans hosts (``create_hybrid_device_mesh``). Single-process (the CPU
+    test mesh): the first islands·island_size devices, island-major —
+    the SAME logical topology, so every factorization is testable on the
+    virtual mesh."""
+    return hybrid_mesh({"data": island_size}, {"dcn": islands},
+                       devices=devices)
+
+
 def process_info() -> Dict[str, int]:
     """Host-level identity (the replacement for the reference's rank arg)."""
     return {

@@ -79,6 +79,22 @@ def sharded_opt_init(mesh: Mesh, params, optimizer: optax.GradientTransformation
     return jax.jit(optimizer.init, out_shardings=out_shardings)(params)
 
 
+def _require_flat_data_mesh(mesh: Mesh, what: str) -> None:
+    """The per-step dp factories reduce over the ``data`` axis only: on a
+    hierarchical (dcn × data) mesh their pmean/scatter would aggregate
+    within islands and silently never cross DCN. Hard error with the
+    pointer to the composing path (compress.make_overlap_* with a per-axis
+    wire dict) — the hierarchical collective layer is the one that knows
+    the two-tier topology."""
+    if mesh.shape.get("dcn", 1) > 1:
+        raise ValueError(
+            f"{what} reduces over the 'data' axis only and would silently "
+            "aggregate per-island on a hierarchical (dcn x data) mesh; "
+            "use the two-level ring driver (parallel/compress.py "
+            "make_overlap_step / make_overlap_multi_step with "
+            'wire={"ici": ..., "dcn": ...})')
+
+
 def _make_local_grad_step(loss_fn: Callable, optimizer, accum_steps: int,
                           guard_nonfinite: bool, comm_scale: int = 1,
                           numerics=None) -> Callable:
@@ -192,6 +208,7 @@ def make_grad_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTrans
     output to ``(loss, NumericsSummary)`` — replicated, computed from the
     post-pmean gradient, bitwise-free for losses/params.
     """
+    _require_flat_data_mesh(mesh, "make_grad_aggregation_step")
     local_step = _make_local_grad_step(loss_fn, optimizer, accum_steps,
                                        guard_nonfinite, numerics=numerics)
     sharded = shard_map(
@@ -227,6 +244,8 @@ def make_multi_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     just triggers one more compile for that shape).
     """
 
+    _require_flat_data_mesh(mesh, "make_multi_step")
+
     def multi(state: TrainState, window):
         local_step = _make_local_grad_step(loss_fn, optimizer, accum_steps,
                                            guard_nonfinite,
@@ -249,6 +268,7 @@ def make_weight_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTra
     """Step locally on the local shard's gradient, then average the *weights*
     across shards — the reference's intro_DP_WA semantics, implemented as the
     intended average-in-place (not its no-op bug)."""
+    _require_flat_data_mesh(mesh, "make_weight_aggregation_step")
 
     def local_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
@@ -273,45 +293,100 @@ def make_weight_aggregation_step(loss_fn: Callable, optimizer: optax.GradientTra
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def data_axes(mesh: Mesh):
+    """The mesh axes that together form the data-parallel world, outermost
+    first: ``("dcn", "data")`` on a hierarchical mesh
+    (parallel/distributed.py:hier_data_mesh — ICI islands bridged by DCN),
+    ``("data",)`` otherwise. Every batch-sharding helper and the
+    hierarchical collective layer (parallel/compress.py) read the topology
+    through this one function, so flat and two-tier meshes cannot drift."""
+    if mesh.shape.get("dcn", 1) > 1:
+        return ("dcn", "data")
+    return ("data",)
+
+
+def data_partition(mesh: Mesh):
+    """The PartitionSpec ENTRY for a dim sharded over the data world,
+    normalized for jit-cache stability: a bare axis name when one axis
+    carries the sharding, a tuple only when both hierarchical axes are
+    real (size > 1). Sharding over a size-1 axis is a placement no-op,
+    but the un-normalized spec survives into the state's sharding and
+    differs from what shard_map's outputs report — the donated state
+    would then miss the jit cache on its SECOND dispatch (one silent
+    retrace per driver, caught by the comm_wire_smoke retrace gate)."""
+    axes = data_axes(mesh)
+    if len(axes) == 1:
+        return axes[0]
+    axes = tuple(a for a in axes if mesh.shape[a] > 1)
+    return axes if len(axes) > 1 else axes[0]
+
+
 def _flat_geometry(mesh: Mesh, params):
     """Padded flat-vector geometry shared by ZeRO-1 and the overlapped ring
     driver (parallel/compress.py): ``(n, pad, local, total)`` — n = the
-    ``data`` axis size, total = the param count, pad brings it to a multiple
-    of n, local = (total + pad) // n = one shard's slice (and one ring
-    chunk). One implementation so the slice a ring chunk lands on is always
-    the slice the ZeRO-1 update owns."""
+    data-parallel world size (the ``data`` axis, × the ``dcn`` axis on a
+    hierarchical mesh), total = the param count, pad brings it to a
+    multiple of n, local = (total + pad) // n = one shard's slice (and one
+    ring chunk). One implementation so the slice a ring chunk lands on is
+    always the slice the ZeRO-1 update owns."""
     from ..utils import pytree as pt
 
-    n = mesh.shape["data"]
+    n = 1
+    for a in data_axes(mesh):
+        n *= mesh.shape[a]
     total = pt.param_count(params)
     pad = (-total) % n
     local = (total + pad) // n
     return n, pad, local, total
 
 
+def hier_slice_index(n_dcn: int):
+    """The hierarchical slice-ownership map, trace-time inside
+    ``shard_map``: shard (d, s) owns flat slice ``s·D + d`` — the slice
+    the two-level reduce-scatter's chunk lands on (phase 1 over the ICI
+    ``data`` axis scatters superchunk s, phase 2 over ``dcn`` scatters
+    chunk d within it; see compress.hier_reduce_scatter). THE one rule —
+    the ZeRO-1 setup and the ring drivers both call it, so the reduced
+    chunk always lands on the shard whose update owns it."""
+    return lax.axis_index("data") * n_dcn + lax.axis_index("dcn")
+
+
+def slice_index(mesh: Mesh):
+    """This shard's slice of the padded flat param vector (trace-time,
+    must run inside ``shard_map``): the ``data`` rank on a flat mesh,
+    ``hier_slice_index`` on a hierarchical one."""
+    axes = data_axes(mesh)
+    if len(axes) == 1:
+        return lax.axis_index(axes[0])
+    return hier_slice_index(mesh.shape["dcn"])
+
+
 def _zero1_setup(optimizer, mesh: Mesh, params):
     """Shared ZeRO-1 initialization: the padded flat-vector geometry, the
     local-slice optimizer PartitionSpecs, and the initial TrainState with
-    moments sharded over ``data`` (each shard owns the moments of its 1/n
-    slice — the ``sharded_opt_init`` placement idea taken one step further,
-    from "moments on the right devices" to "each device holds only its
-    slice"). Returns ``(state, opt_specs, n, pad, local, total)``."""
+    moments sharded over the data-parallel world (each shard owns the
+    moments of its 1/n slice — the ``sharded_opt_init`` placement idea
+    taken one step further, from "moments on the right devices" to "each
+    device holds only its slice"; on a hierarchical mesh the slice is the
+    one ``slice_index`` assigns). Returns ``(state, opt_specs, n, pad,
+    local, total)``."""
     from ..utils import pytree as pt
 
+    dpart = data_partition(mesh)
     n, pad, local, total = _flat_geometry(mesh, params)
 
     # PartitionSpecs for the local-slice optimizer state: vector leaves
-    # (mu/nu, [local]) shard over ``data``; scalars (count) replicate —
-    # every shard steps them identically.
+    # (mu/nu, [local]) shard over the data world; scalars (count)
+    # replicate — every shard steps them identically.
     abstract_opt = jax.eval_shape(
         optimizer.init, jax.ShapeDtypeStruct((local,), jnp.float32))
     opt_specs = jax.tree.map(
-        lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(),
+        lambda x: P(dpart) if getattr(x, "ndim", 0) >= 1 else P(),
         abstract_opt)
 
     def local_init(params):
         # Each shard owns moments for its slice of the padded flat vector.
-        shard = lax.axis_index("data")
+        shard = slice_index(mesh)
         flat = jnp.pad(pt.flatten(params)[0].astype(jnp.float32), (0, pad))
         mine = lax.dynamic_slice_in_dim(flat, shard * local, local)
         return optimizer.init(mine)
@@ -432,6 +507,7 @@ def make_zero1_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     state that caps model size) is what ZeRO-1 is for; a fully flat-resident
     params layout would trade API simplicity for removing the transient.
     """
+    _require_flat_data_mesh(mesh, "make_zero1_step")
     state, opt_specs, n, pad, local, total = _zero1_setup(optimizer, mesh,
                                                           params)
     local_step = _make_zero1_local_step(loss_fn, optimizer, n, pad, local,
@@ -459,6 +535,7 @@ def make_zero1_multi_step(loss_fn: Callable,
     sharded in the scan carry throughout. Same equivalence contract as
     ``make_zero1_step`` (fp32-tolerance vs the replicated update), same
     per-step wire bytes (comm profile records ``scale=K``)."""
+    _require_flat_data_mesh(mesh, "make_zero1_multi_step")
     state, opt_specs, n, pad, local, total = _zero1_setup(optimizer, mesh,
                                                           params)
 
@@ -524,15 +601,21 @@ def host_snapshot(state):
 
 def shard_batch(mesh: Mesh, batch) -> jax.Array:
     """Device-put a [n_shards·B, ...] host batch with leading axis sharded
-    over ``data``."""
-    return jax.device_put(batch, NamedSharding(mesh, P("data")))
+    over the data-parallel world — ``data``, or ``("dcn", "data")``
+    island-major on a hierarchical mesh (shard (d, s) reads batch rows
+    [(d·S+s)·B, (d·S+s+1)·B), matching the device order)."""
+    return jax.device_put(batch,
+                          NamedSharding(mesh, P(data_partition(mesh))))
 
 
 def shard_batch_window(mesh: Mesh, window) -> jax.Array:
     """Device-put a [K, n_shards·B, T] host batch window for the multi-step
     drivers: leading axis = K consecutive steps (replicated — every shard
-    scans the same step sequence), second axis sharded over ``data``."""
-    return jax.device_put(window, NamedSharding(mesh, P(None, "data")))
+    scans the same step sequence), second axis sharded over the
+    data-parallel world (``data``, or ``("dcn", "data")`` hierarchically —
+    same rule as ``shard_batch``)."""
+    return jax.device_put(
+        window, NamedSharding(mesh, P(None, data_partition(mesh))))
 
 
 def replicate(mesh: Mesh, tree):

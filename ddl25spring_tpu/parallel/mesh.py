@@ -21,7 +21,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-AXES = ("data", "stage", "model", "seq", "expert")  # canonical axis order
+# Canonical axis order, outermost first. ``dcn`` is the cross-host tier of
+# a hierarchical data-parallel mesh (parallel/distributed.py:hier_data_mesh)
+# — islands of fast ICI bridged by slow DCN — and sits outermost so the
+# device order is island-major: replica (d, s) = device d·island_size + s.
+# Meshes without a ``dcn`` axis are laid out exactly as before.
+AXES = ("dcn", "data", "stage", "model", "seq", "expert")
 
 
 def make_mesh(axis_sizes: Optional[Dict[str, int]] = None, *,

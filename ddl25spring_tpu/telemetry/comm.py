@@ -125,6 +125,24 @@ class CommProfile:
             agg["wire_bytes_per_device"] += r.wire_bytes_per_device * r.scale
         return out
 
+    def by_axis(self) -> Dict[str, dict]:
+        """Per-MESH-AXIS aggregates — the hierarchical-collective budget
+        view (parallel/compress.py two-level drivers): every record
+        carries the axis its collective crossed, so DCN-axis bytes (the
+        scarce tier of a ``hier_data_mesh``) aggregate separately from
+        ICI-axis bytes. The CI wire gate (experiments/comm_wire_smoke.py)
+        reads the ``dcn`` entry; the flat ring's single ``data`` axis
+        aggregates exactly as the per-step totals do."""
+        out: Dict[str, dict] = {}
+        for r in self.records:
+            agg = out.setdefault(r.axis, {
+                "axis_size": r.axis_size, "calls": 0, "payload_bytes": 0,
+                "wire_bytes_per_device": 0.0})
+            agg["calls"] += r.scale
+            agg["payload_bytes"] += r.payload_bytes * r.scale
+            agg["wire_bytes_per_device"] += r.wire_bytes_per_device * r.scale
+        return out
+
     def as_dict(self, *, steps_per_dispatch: int = 1,
                 overlap_microbatches: int = 1) -> dict:
         """JSON-able shape for the run manifest / bench telemetry block.
@@ -152,6 +170,16 @@ class CommProfile:
             "wire_bytes_per_device_per_step":
                 self.wire_bytes_per_device_per_step,
             "collectives": self.by_label(),
+            # Per-axis attribution (``by_axis``): on a hierarchical mesh
+            # the ``dcn`` entry IS the scarce-tier budget; per-train-step
+            # normalization follows the same ÷K-only rule as the totals.
+            "axes": {
+                ax: {**agg, **({"wire_bytes_per_device_per_train_step":
+                                agg["wire_bytes_per_device"]
+                                / steps_per_dispatch}
+                               if steps_per_dispatch > 1 else {})}
+                for ax, agg in self.by_axis().items()
+            },
         }
         if steps_per_dispatch > 1:
             d["steps_per_dispatch"] = int(steps_per_dispatch)

@@ -166,7 +166,7 @@ class NumericsHandle:
 
 def make_summarizer(params_template, *,
                     layered_keys: Tuple[str, ...] = ("blocks",),
-                    psum_axis: Optional[str] = None) -> NumericsHandle:
+                    psum_axis=None) -> NumericsHandle:
     """Build the in-jit numerics summarizer for one params tree.
 
     ``summarize(params, grads, new_params)`` must be called inside the
@@ -179,6 +179,8 @@ def make_summarizer(params_template, *,
     stats (and the finite mask) are psum-agreed over the named axis —
     one tiny extra collective ([G]+[L] scalars) INSIDE the same
     dispatch; the replicated-gradient path passes None and pays nothing.
+    Accepts a tuple of axis names too — the overlap/ring drivers agree
+    over every data axis of a hierarchical (dcn × data) mesh.
     The psum'd grad norm is then the RMS-style Σ-over-shards of local
     grads (a drift/NaN signal, not bitwise the pmean'd gradient's norm —
     documented, since only zero1 takes this branch).

@@ -231,6 +231,7 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               telemetry=None, steps_per_dispatch: int = 1,
               window_shard_fn=None, numerics=None,
               numerics_every: int = 0, compile_watch=None,
+              injit_guard: bool = False,
               on_checkpoint=None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
@@ -305,6 +306,12 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     report = LLMTrainReport()
     report.start_step = start_step
     report.resilience = stats if stats is not None else ResilienceStats()
+    # In-jit guard accounting (``guard_nonfinite`` fused into the step —
+    # ResilienceConfig.injit_guard): a skipped step's ONLY host-visible
+    # trace is the non-advancing state.step counter, so snapshot it now
+    # (post-restore) and diff once at the end — zero extra syncs per step.
+    injit_step0 = (int(jax.device_get(state.step))
+                   if injit_guard and hasattr(state, "step") else None)
     spans = Spans()  # phase accounting; absorbed into the registry at end
     # One tracing path (telemetry/trace.py): dispatch spans feed the SAME
     # phase accumulator they always did, and additionally land in the
@@ -618,6 +625,12 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
     _flush_losses()  # preempted/odd-tail runs: drain whatever is buffered
     report.steps = (last_it + 1 if report.preempted else train_cfg.iters) \
         - start_step
+    if injit_step0 is not None:
+        # Executed steps minus step-counter advances = fused-guard skips
+        # (the select-back keeps state.step frozen on a bad step). One
+        # scalar sync, after the loop — the skip itself never left jit.
+        good = int(jax.device_get(state.step)) - injit_step0
+        report.resilience.skipped_steps += max(0, report.steps - good)
     if t_start is not None and report.steps > excluded_steps:
         report.wall_time = time.perf_counter() - t_start
         timed = report.steps - excluded_steps
@@ -967,8 +980,19 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     chunks in the ``wire`` format — the one path where wire compression
     composes with zero1 AND steps_per_dispatch. int8 EF residuals live in
     the state tree, so checkpoints/preemption carry them exactly. Replaces
-    ``accum_steps`` (same batch axis); numerics/elastic do not compose
-    yet.
+    ``accum_steps`` (same batch axis); ``numerics_every`` and the fused
+    ``injit_guard`` compose (elastic does not yet).
+
+    ``train_cfg.dcn`` = D > 1 makes the DP world HIERARCHICAL: D ICI
+    islands of ``data`` replicas bridged by DCN (hier_data_mesh), with
+    gradient sync through the TWO-LEVEL ring driver (requires
+    ``overlap_microbatches`` >= 1) — full-precision reduce-scatter within
+    each island (``wire``: fp32/bf16), the exchange across the DCN axis
+    in ``wire_dcn`` (int8+EF is the headline: ~1/S of the vector crosses
+    DCN, at one byte/element), then the intra-island gather. The
+    telemetry comm profile attributes bytes per mesh axis, so the DCN
+    budget is first-class (manifest ``comm.axes``, gated in
+    experiments/comm_wire_smoke.py).
 
     ``loss_sink(it, loss)`` fires every ``sink_every`` iterations with the
     host-synced loss — for incremental result recording that survives a
@@ -1015,8 +1039,31 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
     train_cfg = train_cfg or TrainConfig()
-    mesh = mesh or make_mesh({"data": train_cfg.data})
-    n_data = mesh.shape.get("data", 1)
+    if mesh is None:
+        if train_cfg.dcn > 1:
+            # Hierarchical DP: dcn ICI islands of ``data`` replicas,
+            # bridged by DCN (parallel/distributed.py:hier_data_mesh).
+            from ..parallel.distributed import hier_data_mesh
+            mesh = hier_data_mesh(train_cfg.dcn, train_cfg.data)
+        else:
+            mesh = make_mesh({"data": train_cfg.data})
+    n_dcn = mesh.shape.get("dcn", 1)
+    # The TOTAL data-parallel world — stream splits, batch shapes and
+    # token accounting all run at dcn·data width on a hierarchical mesh.
+    n_data = mesh.shape.get("data", 1) * n_dcn
+    if train_cfg.wire_dcn and "dcn" not in mesh.shape:
+        raise ValueError(
+            "wire_dcn selects the DCN tier of a hierarchical mesh; set "
+            "TrainConfig.dcn > 1 (or pass a hier_data_mesh)")
+    if train_cfg.dcn > 1 and "dcn" not in mesh.shape:
+        # Same bar as the wire_dcn check above: silently training the
+        # flat ring while the config ASKS for islands would fake a
+        # hierarchical measurement (no comm.axes, no DCN tier).
+        raise ValueError(
+            f"TrainConfig.dcn={train_cfg.dcn} but the supplied mesh has "
+            "no 'dcn' axis — pass a hier_data_mesh (or drop the explicit "
+            "mesh and let the trainer build one)")
+    hier = n_dcn > 1 or (bool(train_cfg.wire_dcn) and "dcn" in mesh.shape)
 
     params = llama.init_llama(jax.random.key(train_cfg.seed), model_cfg)
     optimizer = _make_trainer_optimizer(train_cfg)
@@ -1034,29 +1081,64 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     if ovl < 0:
         raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
     elastic = bool(resilience is not None and resilience.elastic)
+    if hier and ovl == 0:
+        raise ValueError(
+            "a hierarchical mesh (TrainConfig.dcn > 1 / wire_dcn) routes "
+            "gradient sync through the two-level ring driver: set "
+            "overlap_microbatches >= 1")
     numerics = None
     if train_cfg.numerics_every > 0:
-        if ovl:
-            raise ValueError("numerics_every does not compose with "
-                             "overlap_microbatches yet (the ring driver "
-                             "owns its collective schedule)")
         # In-jit run-health numerics (telemetry/introspect.py): supported
-        # exactly where the shared step body lives — gradient/zero1 on the
-        # fp32 wire, non-elastic (the compressed steps own their collective
-        # schedules; the elastic rebuild path has no consumer yet). Hard
-        # errors, not silent no-ops: a chaos run that THINKS it is
-        # instrumented but isn't would produce attribution-free bundles.
+        # wherever a shared step body computes it — gradient/zero1 on the
+        # fp32 wire, AND the overlap/ring drivers at any wire format and
+        # topology (the summary rides the step outputs; the ring schedule
+        # is untouched). Hard errors elsewhere, not silent no-ops: a
+        # chaos run that THINKS it is instrumented but isn't would
+        # produce attribution-free bundles.
         if aggregation not in ("gradient", "zero1"):
             raise ValueError("numerics_every requires gradient or zero1 "
                              f"aggregation (got {aggregation!r})")
-        if train_cfg.wire != "fp32":
-            raise ValueError("numerics_every requires wire='fp32'")
+        if ovl == 0 and train_cfg.wire != "fp32":
+            raise ValueError(
+                "numerics_every requires wire='fp32' on the legacy "
+                "per-step compressed paths (they own their collective "
+                "schedules) — overlap_microbatches >= 1 is the composing "
+                "path")
         if elastic:
             raise ValueError("numerics_every does not compose with "
                              "elastic mode yet")
-        numerics = introspect.make_summarizer(
-            params,
-            psum_axis="data" if aggregation == "zero1" else None)
+        if ovl:
+            # Overlap/ring drivers: local gradients differ per shard in
+            # BOTH aggregations, so the summarizer psum-agrees grad stats
+            # over every data axis of the (possibly hierarchical) mesh.
+            psum_axis = ("dcn", "data") if hier else "data"
+        else:
+            psum_axis = "data" if aggregation == "zero1" else None
+        numerics = introspect.make_summarizer(params, psum_axis=psum_axis)
+    injit_guard = bool(resilience is not None and resilience.injit_guard)
+    if injit_guard:
+        # The fused in-jit skip (parallel/{dp,compress}.py
+        # guard_nonfinite): select-back without leaving jit, the
+        # non-advancing step counter counted into
+        # ResilienceStats.skipped_steps at the end-of-run sync.
+        if resilience.guard:
+            raise ValueError(
+                "injit_guard and guard are mutually exclusive skip "
+                "mechanisms (the host StepGuard would double-count the "
+                "fused skip); set ResilienceConfig(guard=False) to use "
+                "the in-jit guard")
+        if elastic:
+            raise ValueError("injit_guard does not compose with elastic "
+                             "mode (the remesh path rebuilds its own "
+                             "steps)")
+        if aggregation not in ("gradient", "zero1"):
+            raise ValueError("injit_guard requires gradient or zero1 "
+                             f"aggregation (got {aggregation!r})")
+        if ovl == 0 and train_cfg.wire != "fp32":
+            raise ValueError(
+                "injit_guard is not fused into the legacy per-step "
+                "compressed paths — overlap_microbatches >= 1 is the "
+                "composing path")
     if elastic:
         # Elastic DP (resilience/elastic.py): the loop drives the [K, B, T]
         # window step (K = steps_per_dispatch, 1 included) so replica-loss
@@ -1116,14 +1198,21 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                              "(both split the local batch axis); set "
                              "accum_steps=1")
         from ..parallel import compress
+        # Per-axis wire on the hierarchical mesh: the ICI tier rides
+        # ``wire``, the scarce DCN tier ``wire_dcn`` (default fp32).
+        wire_arg = ({"ici": train_cfg.wire,
+                     "dcn": train_cfg.wire_dcn or "fp32"}
+                    if hier else train_cfg.wire)
         if spd > 1:
             state, step_fn = compress.make_overlap_multi_step(
                 loss_fn, optimizer, mesh, params, microbatches=ovl,
-                wire=train_cfg.wire, aggregation=aggregation)
+                wire=wire_arg, aggregation=aggregation,
+                guard_nonfinite=injit_guard, numerics=numerics)
         else:
             state, step_fn = compress.make_overlap_step(
                 loss_fn, optimizer, mesh, params, microbatches=ovl,
-                wire=train_cfg.wire, aggregation=aggregation)
+                wire=wire_arg, aggregation=aggregation,
+                guard_nonfinite=injit_guard, numerics=numerics)
     elif train_cfg.wire != "fp32":
         # Compressed gradient allreduce (parallel/compress.py) — gradient
         # aggregation only, and accumulation stays at 1 (the compressed
@@ -1156,23 +1245,24 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         if elastic:
             state, step_fn, window_shard = _build_elastic(mesh)
         elif spd > 1:
-            state, step_fn = dp.make_zero1_multi_step(loss_fn, optimizer,
-                                                      mesh, params,
-                                                      numerics=numerics)
+            state, step_fn = dp.make_zero1_multi_step(
+                loss_fn, optimizer, mesh, params,
+                guard_nonfinite=injit_guard, numerics=numerics)
         else:
-            state, step_fn = dp.make_zero1_step(loss_fn, optimizer, mesh,
-                                                params, numerics=numerics)
+            state, step_fn = dp.make_zero1_step(
+                loss_fn, optimizer, mesh, params,
+                guard_nonfinite=injit_guard, numerics=numerics)
     elif aggregation == "gradient":
         if elastic:
             state, step_fn, window_shard = _build_elastic(mesh)
         elif spd > 1:
             step_fn = dp.make_multi_step(
                 loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps,
-                numerics=numerics)
+                guard_nonfinite=injit_guard, numerics=numerics)
         else:
             step_fn = dp.make_grad_aggregation_step(
                 loss_fn, optimizer, mesh, accum_steps=train_cfg.accum_steps,
-                numerics=numerics)
+                guard_nonfinite=injit_guard, numerics=numerics)
     elif aggregation == "weight":
         if train_cfg.accum_steps != 1:
             raise ValueError("accum_steps needs gradient aggregation")
@@ -1200,7 +1290,10 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             step_fn,
             name=f"train/dp-{aggregation}"
                  + (f"-k{spd}" if spd > 1 else "")
-                 + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""),
+                 + ((f"-hier{n_dcn}x{mesh.shape['data']}"
+                     f"-{train_cfg.wire}/{train_cfg.wire_dcn or 'fp32'}"
+                     f"-m{ovl}") if hier else
+                    (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")),
             max_caches=(1 if spd == 1 else None),
             events=(telemetry.events if telemetry is not None else None),
             # Chunked mode stamps each compile event with the COMPILING
@@ -1272,6 +1365,7 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      numerics=numerics,
                      numerics_every=train_cfg.numerics_every,
                      compile_watch=compile_watch,
+                     injit_guard=injit_guard,
                      on_checkpoint=on_checkpoint)
 
 
@@ -1315,6 +1409,10 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     if train_cfg.wire != "fp32":
         raise ValueError("wire compression (TrainConfig.wire) is DP-trainer-"
                          "only; the pipeline step owns its own collectives")
+    if train_cfg.dcn != 1 or train_cfg.wire_dcn:
+        raise ValueError("hierarchical DP (TrainConfig.dcn / wire_dcn) is "
+                         "DP-trainer-only; the pipeline mesh has no "
+                         "two-level data tier")
     if train_cfg.overlap_microbatches != 0:
         raise ValueError("overlap_microbatches (the ring-overlap driver) is "
                          "DP-trainer-only; the pipeline schedule already "

@@ -39,22 +39,25 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                 telemetry_dir: str = None, steps_per_dispatch: int = 1,
                 zero1: bool = False, elastic: bool = False,
                 numerics_every: int = 0, wire: str = "fp32",
-                overlap_microbatches: int = 0) -> Dict[str, float]:
+                overlap_microbatches: int = 0, dcn: int = 1,
+                wire_dcn: str = "") -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
     if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1 or elastic
                               or numerics_every or wire != "fp32"
-                              or overlap_microbatches):
+                              or overlap_microbatches or dcn > 1
+                              or wire_dcn):
         # These levers are DP-trainer-only (the PP step owns its
         # own schedule/collectives); failing loudly beats silently timing
         # the wrong program.
         raise ValueError(f"--steps-per-dispatch/--zero1/--elastic/"
-                         f"--numerics-every/--wire/--overlap-microbatches "
-                         f"need a DP config (got {name})")
+                         f"--numerics-every/--wire/--overlap-microbatches/"
+                         f"--dcn/--wire-dcn need a DP config (got {name})")
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
                             numerics_every=numerics_every, wire=wire,
                             overlap_microbatches=overlap_microbatches,
+                            dcn=dcn, wire_dcn=wire_dcn,
                             **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
     label = f"{name}_b{train_cfg.data * train_cfg.batch_size}_seq256_adam8e-4"
@@ -66,6 +69,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
         label += f"_{wire}"
     if overlap_microbatches:
         label += f"_ring_m{overlap_microbatches}"
+    if dcn > 1:
+        label += f"_hier{dcn}x{train_cfg.data}_{wire_dcn or 'fp32'}"
     log_every = max(1, min(iters // 10, 25))
     kw = {}
     if checkpoint_dir is not None:
@@ -150,7 +155,8 @@ def main(quick: bool = False, iters: int = 5000,
          telemetry_dir: str = None, steps_per_dispatch: int = 1,
          zero1: bool = False, elastic: bool = False,
          numerics_every: int = 0, wire: str = "fp32",
-         overlap_microbatches: int = 0) -> Dict[str, float]:
+         overlap_microbatches: int = 0, dcn: int = 1,
+         wire_dcn: str = "") -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -181,7 +187,8 @@ def main(quick: bool = False, iters: int = 5000,
                                steps_per_dispatch=steps_per_dispatch,
                                zero1=zero1, elastic=elastic,
                                numerics_every=numerics_every, wire=wire,
-                               overlap_microbatches=overlap_microbatches))
+                               overlap_microbatches=overlap_microbatches,
+                               dcn=dcn, wire_dcn=wire_dcn))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
     if len(configs) == 1 and f"{configs[0]}_first" in out:
@@ -250,6 +257,17 @@ if __name__ == "__main__":
                          "in-flight chunks in --wire's format; 1 = "
                          "no-split compressed ring, 0 = legacy paths; "
                          "DP configs only")
+    ap.add_argument("--dcn", type=int, default=1,
+                    help="hierarchical DP: --dcn islands of --data-sized "
+                         "ICI tiers bridged by DCN (hier_data_mesh); the "
+                         "two-level ring driver runs with --wire on the "
+                         "ICI tier and --wire-dcn across DCN (needs "
+                         "--overlap-microbatches >= 1); DP configs only")
+    ap.add_argument("--wire-dcn", default="",
+                    choices=["", "fp32", "bf16", "int8_ef"],
+                    help="DCN-tier wire format of the two-level "
+                         "hierarchical collectives (int8_ef = the "
+                         "compress-where-scarce headline)")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic DP (resilience/elastic.py): survive "
                          "replica loss (inject with --faults "
@@ -271,4 +289,5 @@ if __name__ == "__main__":
          telemetry_dir=a.telemetry_dir,
          steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1,
          elastic=a.elastic, numerics_every=a.numerics_every, wire=a.wire,
-         overlap_microbatches=a.overlap_microbatches)
+         overlap_microbatches=a.overlap_microbatches, dcn=a.dcn,
+         wire_dcn=a.wire_dcn)

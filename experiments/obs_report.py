@@ -105,6 +105,22 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                   f"({agg['axis_size']})  x{agg['calls']:<5d} "
                   f"payload {_fmt_bytes(agg['payload_bytes']):>12s}  "
                   f"wire {_fmt_bytes(agg['wire_bytes_per_device']):>12s}")
+        # Per-mesh-axis attribution (hierarchical collectives): the DCN
+        # row IS the scarce-tier wire budget. Absent on pre-PR-12
+        # manifests — skip silently.
+        axes = comm.get("axes")
+        if axes and len(axes) > 1:
+            print("per-axis wire budget:")
+            for ax, agg in sorted(axes.items(),
+                                  key=lambda kv:
+                                  -kv[1]["wire_bytes_per_device"]):
+                per_ts = agg.get("wire_bytes_per_device_per_train_step")
+                print(f"  axis {ax:6s}({agg['axis_size']})  x"
+                      f"{agg['calls']:<5d} payload "
+                      f"{_fmt_bytes(agg['payload_bytes']):>12s}  wire "
+                      f"{_fmt_bytes(agg['wire_bytes_per_device']):>12s}"
+                      + (f"  ({_fmt_bytes(per_ts)}/step)"
+                         if per_ts is not None else ""))
 
     if steps:
         _section("steps")

@@ -506,6 +506,9 @@ def test_overlap_trainer_composition_and_guards(devices):
     with pytest.raises(ValueError, match="accum_steps"):
         train_llm_dp(cfg, TrainConfig(**base, accum_steps=2),
                      tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0)
-    with pytest.raises(ValueError, match="numerics_every"):
-        train_llm_dp(cfg, TrainConfig(**base, numerics_every=2),
-                     tokenizer=ByteTokenizer(), mesh=mesh(), log_every=0)
+    # numerics_every now COMPOSES with the ring driver (PR 12 satellite —
+    # was a hard error): same trajectory bitwise, instrumentation on.
+    instr = train_llm_dp(cfg, TrainConfig(**base, numerics_every=2),
+                         tokenizer=ByteTokenizer(), aggregation="zero1",
+                         mesh=mesh(), log_every=0)
+    assert instr.losses == ref.losses
