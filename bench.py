@@ -580,6 +580,31 @@ def main():
               f"p99 TTFT {agg['ttft_s']['p99'] * 1e3:7.1f} ms  "
               f"peak blocks {rep.peak_blocks_in_use}/{rep.pool_blocks}",
               file=sys.stderr)
+
+        # Speculative decode row (serving/speculate.py): the same engine
+        # with a same-weights draft at k=4 — greedy acceptance is
+        # deterministically 1, so tokens-per-dispatch is the exact
+        # (k+1)-window arithmetic, measured at batch 1 where the decode
+        # roofline is weight-bound and per-dispatch cost IS the lever
+        # (ROOFLINE.md "speculative decode" row). bench_compare treats
+        # tokens_per_dispatch as higher-is-better (its default).
+        from ddl25spring_tpu.serving import Request as _Req
+        from ddl25spring_tpu.serving import SpecConfig
+        seq_wl = [_Req(rid=r.rid, prompt=r.prompt, max_new=r.max_new,
+                       arrival=0.0) for r in wl[:max(8, n_req // 4)]]
+        rep1 = run_serving(sparams, scfg, paged, seq_wl, num_slots=1,
+                           prefill_chunk=8, token_events=False)
+        rep_spec = run_serving(
+            sparams, scfg, paged, seq_wl, num_slots=1, prefill_chunk=8,
+            token_events=False,
+            speculate=SpecConfig(k=4, draft_params=sparams))
+        print(f"serving spec-k4 (batch 1):  "
+              f"{rep_spec.tokens_per_dispatch:5.2f} tok/dispatch vs "
+              f"{rep1.tokens_per_dispatch:4.2f} plain  "
+              f"(acceptance {rep_spec.acceptance_rate:.2f}, "
+              f"{rep_spec.decode_dispatches} vs "
+              f"{rep1.decode_dispatches} dispatches)",
+              file=sys.stderr)
     except Exception as e:
         print(f"serving bench: failed ({type(e).__name__}: {e})",
               file=sys.stderr)

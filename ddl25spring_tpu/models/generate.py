@@ -241,3 +241,64 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     carry = (cache, first, jnp.asarray(tp, jnp.int32), key)
     _, rest = lax.scan(step, carry, None, length=max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def speculative_stream(params: dict, draft_params: dict,
+                       prompt, cfg: LlamaConfig, max_new_tokens: int, *,
+                       k: int, draft_cfg: Optional[LlamaConfig] = None):
+    """REFERENCE greedy speculative decoding — the parity twin of the
+    serving engine's draft-propose / verify round (serving/speculate.py),
+    written as the obviously-correct O(T²) re-forward loop (the same
+    style as tests/test_generate.py's greedy reference): the draft
+    proposes ``k`` tokens by argmax over its own full forward, the target
+    scores the whole window in one forward, and the accepted prefix plus
+    one correction/bonus token extends the stream.
+
+    Greedy speculative decoding emits EXACTLY the greedy stream — every
+    accepted token is re-derived as the target's own argmax and so is the
+    token beyond the accepted prefix — so the returned tokens equal
+    ``generate(params, prompt, cfg, max_new_tokens)``'s bitwise at any
+    ``k`` and any draft (pinned in tests/test_generate.py). Returns
+    ``(tokens, stats)`` with ``stats`` counting proposed/accepted draft
+    tokens and target rounds — the acceptance-rate accounting the
+    engine's schema-v7 ``speculate`` events report per dispatch.
+
+    Deliberately NOT a production path (each round re-runs full forwards;
+    one compile per sequence length): it exists so the engine's
+    one-dispatch verify program has an independent, hand-checkable
+    reference for both the emitted stream and the acceptance counts."""
+    dcfg = draft_cfg or cfg
+    if k < 1 or max_new_tokens < 1:
+        raise ValueError(f"k={k}, max_new_tokens={max_new_tokens}")
+    seq = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
+    out = []
+    stats = {"proposed": 0, "accepted": 0, "rounds": 0, "k": k}
+    while len(out) < max_new_tokens:
+        d_seq = seq
+        drafts = []
+        for _ in range(k):
+            d_log = llama.forward(draft_params, d_seq, dcfg)[:, -1, :]
+            d_tok = jnp.argmax(d_log, axis=-1)
+            drafts.append(int(d_tok[0]))
+            d_seq = jnp.concatenate([d_seq, d_tok[:, None]], axis=1)
+        window = jnp.concatenate(
+            [seq, jnp.asarray(drafts, jnp.int32)[None, :]], axis=1)
+        t_log = llama.forward(params, window, cfg)[0]          # [T, V]
+        base = seq.shape[1] - 1
+        targets = [int(jnp.argmax(t_log[base + i])) for i in range(k + 1)]
+        a = 0
+        while a < k and targets[a] == drafts[a]:
+            a += 1
+        remaining = max_new_tokens - len(out)
+        emit = targets[:a + 1][:remaining]
+        # Horizon truncation never reads as rejection: proposals past
+        # max_new could never be emitted, so — the engine's schema-v7
+        # rule — only min(k, remaining) count as proposed (a same-weights
+        # draft stays at acceptance exactly 1 at any max_new).
+        stats["proposed"] += min(k, remaining)
+        stats["accepted"] += min(a, len(emit))
+        stats["rounds"] += 1
+        out.extend(emit)
+        seq = jnp.concatenate(
+            [seq, jnp.asarray(emit, jnp.int32)[None, :]], axis=1)
+    return out, stats

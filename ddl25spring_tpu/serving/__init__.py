@@ -6,11 +6,17 @@ Six layers (ISSUE 6 + ISSUE 11 / ROADMAP item 1), bottom-up:
               sequences of different lengths share one pool through
               per-slot block tables instead of each owning a ``max_len``
               cache (vLLM-style paging, static-shape/one-compile).
-- engine    — ``prefill_chunk`` / ``decode_step`` compiled ONCE over a
-              fixed slot axis; chunked prefill interleaves with in-flight
-              decode; token-boundary weight hot-swap seam
-              (``swap_params``); bitwise-parity with ``models.generate``
-              pinned in tests.
+- engine    — ``prefill_chunk`` / ``decode_step`` (+ ``verify_step``
+              when speculating) compiled ONCE over a fixed slot axis;
+              chunked prefill interleaves with in-flight decode;
+              token-boundary weight hot-swap seam (``swap_params``);
+              CoW prefix sharing (``prefix_share``) and bucketed gather
+              narrowing (``gather_buckets``); bitwise-parity with
+              ``models.generate`` pinned in tests.
+- speculate — draft-propose / one-dispatch-verify speculative decoding
+              (``SpecConfig``, ``DraftEngine``, ``make_verify_step``):
+              greedy streams bitwise ``generate()``'s, stochastic via
+              rejection sampling; schema-v7 ``speculate`` events.
 - scheduler — Orca-style iteration-level (continuous) batching:
               reservation-based admission (never deadlocks) behind a
               policy seam (FCFS default; size-aware "sjf"; priorities),
@@ -42,3 +48,4 @@ from .kvcache import (TRASH_BLOCK, BlockAllocator,  # noqa: F401
                       PagedKVConfig, blocks_for, init_pool,
                       kv_bytes_per_token, naive_cache_bytes, pool_bytes)
 from .scheduler import Request, RequestRecord, Scheduler  # noqa: F401
+from .speculate import DraftEngine, SpecConfig  # noqa: F401

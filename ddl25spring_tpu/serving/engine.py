@@ -13,9 +13,14 @@ math into TWO reusable compiled programs over a fixed slot axis ``[S]``:
 - ``decode_step``: one token for ALL slots ``[S]`` at once — per-slot
   position, RNG key, temperature and active-mask ride in the slot state, so
   admissions/retirements between steps never recompile anything.
+- ``verify_step`` (speculation armed, serving/speculate.py): the decode
+  step widened to a ``k+1``-position window per slot — one dispatch
+  scores a draft's whole proposal, so decode throughput scales with the
+  acceptance rate instead of paying one dispatch per token.
 
-Both are compiled exactly once per engine (static shapes; the pool is
-donated so XLA updates blocks in place), and both are built from the same
+Each is compiled exactly once per engine (static shapes; the pool is
+donated so XLA updates blocks in place — with gather narrowing, once per
+bucketed table width), and all are built from the same
 building blocks as ``generate`` — ``_fuse_blocks``, ``llama.embed/head``,
 the fp32-softmax attention layout of ``_attend_cached`` — deliberately
 op-for-op, because the acceptance bar is BITWISE: a request decoded here,
@@ -219,17 +224,27 @@ def make_prefill_chunk(cfg: LlamaConfig, paged: PagedKVConfig,
     next-token sample from the chunk's last VALID row — the host uses it
     only for the final chunk (``generate`` splits its key exactly once
     after prefill, so intermediate chunks must not consume randomness:
-    the caller passes the key only when ``is_final``)."""
+    the caller passes the key only when ``is_final``).
+
+    ``write_from`` (CoW prefix sharing, kvcache.py): positions below it
+    route their K/V writes to the trash block — the slot READS those
+    positions from blocks it shares with an earlier identical prefix, so
+    re-writing them would scribble on another request's read-only blocks.
+    The recomputed values are bitwise the shared ones (same tokens, same
+    positions, same weights), so discarding them changes nothing. 0 (the
+    non-sharing case) writes everything, byte-for-byte the old program."""
     bl, mb = paged.block_len, paged.max_blocks_per_seq
 
     @partial(jax.jit, donate_argnums=(0,))
     def prefill_chunk(pool: dict, params: dict, fused: dict,
                       table_row: jnp.ndarray, tokens: jnp.ndarray,
                       start: jnp.ndarray, n_valid: jnp.ndarray,
+                      write_from: jnp.ndarray,
                       key: jnp.ndarray, temperature: jnp.ndarray):
         start = jnp.asarray(start, jnp.int32)
         pos = start + jnp.arange(chunk_len, dtype=jnp.int32)       # [Tc]
-        valid = jnp.arange(chunk_len) < n_valid
+        valid = jnp.logical_and(jnp.arange(chunk_len) < n_valid,
+                                pos >= write_from)
         blk_idx = jnp.minimum(pos // bl, mb - 1)
         wblk = jnp.where(valid, table_row[blk_idx], TRASH_BLOCK)
         woff = pos % bl
@@ -250,19 +265,32 @@ def make_prefill_chunk(cfg: LlamaConfig, paged: PagedKVConfig,
 
 def make_decode_step(cfg: LlamaConfig, paged: PagedKVConfig,
                      num_slots: int, top_k: Optional[int],
-                     top_p: Optional[float]):
+                     top_p: Optional[float], *, return_probs: bool = False):
     """One compiled program: one token for ALL ``num_slots`` slots. Each
     slot feeds back its last token at its own position, writes K/V into its
     own blocks (inactive slots write to trash), and samples with its own
     key/temperature. Admission, retirement and raggedness are pure data —
-    the program never recompiles."""
-    bl, mb = paged.block_len, paged.max_blocks_per_seq
+    the program never recompiles. The table WIDTH is read from the
+    argument shape, not the pool config: with gather narrowing
+    (``Engine(gather_buckets=True)``) the host passes a bucketed slice of
+    the block table and each bucket width is its own (once-compiled)
+    specialization of this one program.
+
+    ``return_probs=True`` is the DRAFT variant (serving/speculate.py):
+    identical cache indexing, key discipline and sampling, but the program
+    additionally returns the sampling distribution ``q`` per slot (post
+    temperature/top_k/top_p — the ``q`` of the rejection test, so
+    acceptance uses exactly the distribution the proposal was drawn from).
+    One body serves both so a fix to the paged-cache math can never drift
+    between target and draft."""
+    bl = paged.block_len
 
     @partial(jax.jit, donate_argnums=(0,))
     def decode_step(pool: dict, params: dict, fused: dict,
                     tables: jnp.ndarray, last_tok: jnp.ndarray,
                     pos: jnp.ndarray, keys: jnp.ndarray,
                     temps: jnp.ndarray, active: jnp.ndarray):
+        mb = tables.shape[1]
         blk_idx = jnp.minimum(pos // bl, mb - 1)
         own = jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0]
         wblk = jnp.where(active, own, TRASH_BLOCK)
@@ -281,7 +309,15 @@ def make_decode_step(cfg: LlamaConfig, paged: PagedKVConfig,
         toks = jax.vmap(
             lambda k, l, t: _sample_slot(k, l[None], t, top_k, top_p)[0]
         )(subs, logits, temps)
-        return pool, toks, new_keys
+        if not return_probs:
+            return pool, toks, new_keys
+        # Greedy slots' q is unused (their acceptance is the argmax
+        # comparison); it is still computed, ``where``-select style, so
+        # one compile serves any per-slot mix.
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        q = jax.nn.softmax(
+            generate.filter_logits(logits / safe_t, top_k, top_p), axis=-1)
+        return pool, toks, q, new_keys
 
     return decode_step
 
@@ -299,10 +335,12 @@ class TokenEvent(NamedTuple):
 
 class _Slot:
     __slots__ = ("blocks", "prompt", "max_new", "produced", "prefill_off",
-                 "phase", "seq")
+                 "phase", "seq", "shared", "prompt_key", "registered")
 
-    def __init__(self, blocks, prompt, max_new, seq):
-        self.blocks = blocks          # owned pool block indices
+    def __init__(self, blocks, prompt, max_new, seq, *, shared=0,
+                 prompt_key=None):
+        self.blocks = blocks          # owned pool block indices (refs held
+                                      # on the first ``shared`` of them)
         self.prompt = prompt          # np.int32 [Tp]
         self.max_new = max_new
         self.produced = 0
@@ -311,6 +349,11 @@ class _Slot:
         self.seq = seq                # admission order (prefill is FCFS by
                                       # THIS, not by slot index — a freed
                                       # low slot must not jump the line)
+        self.shared = shared          # leading blocks mapped read-only from
+                                      # an identical prompt prefix (CoW)
+        self.prompt_key = prompt_key  # tuple(prompt) for prefix-cache keys
+        self.registered = shared      # full prompt blocks published into
+                                      # the prefix cache so far
 
 
 class Engine:
@@ -328,7 +371,10 @@ class Engine:
     def __init__(self, params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                  num_slots: int, *, prefill_chunk: int = 16,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 engine_id: Optional[int] = None):
+                 engine_id: Optional[int] = None,
+                 speculate: Optional["SpecConfig"] = None,
+                 prefix_share: bool = False,
+                 gather_buckets: bool = False):
         if num_slots < 1 or prefill_chunk < 1:
             raise ValueError(f"num_slots={num_slots}, "
                              f"prefill_chunk={prefill_chunk}")
@@ -358,12 +404,37 @@ class Engine:
         self.last_tok = np.zeros(num_slots, np.int32)
         self.temps = np.zeros(num_slots, np.float32)
         self.keys = jnp.zeros((num_slots, 2), jnp.uint32)
+        # Copy-on-write prefix sharing (kvcache.py): a host-side map from
+        # a prompt's leading n·block_len tokens to the physical block
+        # holding tokens [(n-1)·bl, n·bl). Entries are published only once
+        # the owning slot's prefill has WRITTEN the block, and evicted
+        # when the last reference frees it — sharing is among live
+        # requests (the persistent-LRU extension is the documented next
+        # step). ``_block_key`` is the eviction reverse map.
+        self.prefix_share = prefix_share
+        self._prefix_blocks: Dict[tuple, int] = {}
+        self._block_key: Dict[int, tuple] = {}
+        # Gather narrowing (opt-in): decode/verify gathers walk only a
+        # BUCKETED prefix of the block table — the fleet-wide max live
+        # block count this dispatch, rounded up to a power of two so the
+        # shape set is bounded (one compile per bucket, zero retraces
+        # after). Byte savings are accounted analytically per dispatch.
+        self.gather_buckets = gather_buckets
+        mb = paged.max_blocks_per_seq
+        self._buckets = sorted({min(1 << i, mb)
+                                for i in range(mb.bit_length() + 1)} | {mb})
+        n_shapes = len(self._buckets) if gather_buckets else 1
+        self.gather_bytes = 0          # gathered KV bytes, as narrowed
+        self.gather_bytes_saved = 0    # bytes the full-width walk would add
         # Compile/retrace observability (telemetry/introspect.py): the
-        # engine's contract is EXACTLY two compiled programs — admission,
-        # retirement and raggedness are data, never shapes. The watches
-        # enforce that as a budget (growth past one cache entry each is a
-        # flagged retrace) and emit ``compile`` events once the scheduler
-        # binds its event stream (introspect.bind_events).
+        # engine's contract is a DOCUMENTED program set — two programs
+        # (prefill_chunk + decode_step) without speculation, three
+        # (+ verify_step; decode_step idles) plus the draft's two with it
+        # — admission, retirement and raggedness are data, never shapes.
+        # Gather narrowing widens each decode/verify budget to one compile
+        # per bucket width. The watches enforce the budgets (growth past
+        # them is a flagged retrace) and emit ``compile`` events once the
+        # scheduler binds its event stream (introspect.bind_events).
         from ..telemetry import introspect
         tag = "" if engine_id is None else f"[{engine_id}]"
         self._prefill = introspect.watch(
@@ -371,7 +442,38 @@ class Engine:
             name=f"serving/prefill_chunk{tag}", max_caches=1)
         self._decode = introspect.watch(
             make_decode_step(cfg, paged, num_slots, top_k, top_p),
-            name=f"serving/decode_step{tag}", max_caches=1)
+            name=f"serving/decode_step{tag}", max_caches=n_shapes)
+        # Speculative decoding (serving/speculate.py): the draft engine
+        # (own pool over the SAME block tables, own two programs) and the
+        # one-dispatch k+1-position verify program.
+        self.spec = speculate
+        self.last_spec: Optional[dict] = None
+        self.decode_dispatches = 0     # verify or plain decode calls
+        self.decode_tokens = 0         # tokens those dispatches emitted
+        self.draft_dispatches = 0
+        if speculate is not None:
+            from .speculate import DraftEngine, make_verify_step
+            self.draft = DraftEngine(
+                speculate, cfg, paged, num_slots,
+                prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p,
+                engine_id=engine_id, decode_shapes=n_shapes)
+            self._verify = introspect.watch(
+                make_verify_step(cfg, paged, num_slots, speculate.k,
+                                 top_k, top_p),
+                name=f"serving/verify_step{tag}", max_caches=n_shapes)
+        else:
+            self.draft = None
+            self._verify = None
+
+    def watches(self) -> list:
+        """The engine's CompileWatch set — its documented program budget.
+        Two entries without speculation (byte-for-byte the historical
+        contract), five with it (prefill + decode + verify + the draft's
+        prefill + decode)."""
+        ws = [self._prefill, self._decode]
+        if self.spec is not None:
+            ws += [self._verify, self.draft._prefill, self.draft._decode]
+        return ws
 
     # ------------------------------------------------------------- admission
     def required_blocks(self, prompt_len: int, max_new: int) -> int:
@@ -379,16 +481,42 @@ class Engine:
         sampled token is never fed back — ``generate``'s horizon)."""
         return blocks_for(prompt_len + max_new - 1, self.paged.block_len)
 
+    def _shared_prefix(self, prompt) -> List[int]:
+        """Physical blocks an admission of ``prompt`` can map read-only:
+        the longest chain of FULL prompt blocks whose exact token prefix
+        is already published in the prefix cache (i.e. written by a live
+        request). Registration is prefix-ordered, so the walk stops at
+        the first miss."""
+        if not self.prefix_share:
+            return []
+        bl = self.paged.block_len
+        key = tuple(int(t) for t in prompt)
+        shared: List[int] = []
+        for n in range(1, len(key) // bl + 1):
+            b = self._prefix_blocks.get(key[:n * bl])
+            if b is None:
+                break
+            shared.append(b)
+        return shared
+
     def free_slot(self) -> Optional[int]:
         for s, slot in enumerate(self.slots):
             if slot is None:
                 return s
         return None
 
-    def can_admit(self, prompt_len: int, max_new: int) -> bool:
-        return (self.free_slot() is not None
-                and self.required_blocks(prompt_len, max_new)
-                <= self.allocator.free_blocks)
+    def can_admit(self, prompt_len: int, max_new: int,
+                  prompt=None) -> bool:
+        """``prompt`` (the token ids) lets CoW-sharing engines credit the
+        blocks a shared prefix saves; without it the check is the
+        conservative full-reservation one (always safe — sharing only
+        ever reduces the fresh-block need)."""
+        if self.free_slot() is None:
+            return False
+        need = self.required_blocks(prompt_len, max_new)
+        if prompt is not None:
+            need -= len(self._shared_prefix(prompt))
+        return need <= self.allocator.free_blocks
 
     def admit(self, prompt, max_new: int, *, temperature: float = 0.0,
               key: Optional[jax.Array] = None) -> int:
@@ -396,7 +524,15 @@ class Engine:
         blocks up front. All-or-nothing reservation is the liveness
         guarantee: an admitted request can always run to completion, so
         pool exhaustion can only ever queue admissions, never deadlock
-        in-flight work (scheduler.py holds the policy argument)."""
+        in-flight work (scheduler.py holds the policy argument).
+
+        With ``prefix_share``, full prompt blocks already written by a
+        live request with the identical prefix are mapped READ-ONLY into
+        this slot's table (allocator refcount, not a fresh grant) and the
+        reservation shrinks by that many blocks; the slot's own writes
+        start at the first un-shared position (its prefill passes
+        ``write_from``), so a shared block is never written twice — the
+        divergent tail always lands in this slot's private blocks."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         tp, mx = len(prompt), int(max_new)
         if tp < 1 or mx < 1:
@@ -409,11 +545,26 @@ class Engine:
         s = self.free_slot()
         if s is None:
             raise RuntimeError("no free slot")
-        blocks = self.allocator.alloc(self.required_blocks(tp, mx))
-        if blocks is None:
+        shared = self._shared_prefix(prompt)
+        fresh = self.allocator.alloc(self.required_blocks(tp, mx)
+                                     - len(shared))
+        if fresh is None:
             raise RuntimeError("pool exhausted")
+        if shared:
+            self.allocator.share(shared)
+        blocks = shared + fresh
         self._admit_seq += 1
-        self.slots[s] = _Slot(blocks, prompt, mx, self._admit_seq)
+        self.slots[s] = _Slot(blocks, prompt, mx, self._admit_seq,
+                              shared=len(shared),
+                              prompt_key=(tuple(int(t) for t in prompt)
+                                          if self.prefix_share else None))
+        # Skip prefilling the shared region (its K/V is already in the
+        # pool, bitwise what this slot would write) — but always run the
+        # chunk holding the LAST prompt token: the first-token sample
+        # needs its hidden state, which only K/V survives of the shared
+        # computation. Writes below write_from go to trash.
+        self.slots[s].prefill_off = min(len(shared) * self.paged.block_len,
+                                        tp - 1)
         self.tables[s] = TRASH_BLOCK
         self.tables[s, :len(blocks)] = blocks
         self.pos[s] = 0
@@ -423,6 +574,8 @@ class Engine:
                 raise ValueError("sampling (temperature>0) requires a key")
             key = jax.random.PRNGKey(0)      # unused by greedy (generate's
         self.keys = self.keys.at[s].set(key)  # own placeholder convention)
+        if self.draft is not None:
+            self.draft.admit_key(s, temperature, key)
         return s
 
     # ----------------------------------------------------------- one boundary
@@ -467,15 +620,37 @@ class Engine:
 
     def step(self) -> List[TokenEvent]:
         """One token boundary: one prefill chunk (if a slot is mid-prefill),
-        then one decode step over the decoding slots."""
+        then one decode step — or, with speculation, one draft-propose +
+        verify round — over the decoding slots."""
         events: List[TokenEvent] = []
+        self.last_spec = None
         prefilling = [(sl.seq, i) for i, sl in enumerate(self.slots)
                       if sl is not None and sl.phase == "prefill"]
         if prefilling:
             events.extend(self._advance_prefill(min(prefilling)[1]))
         if any(sl is not None and sl.phase == "decode" for sl in self.slots):
-            events.extend(self._advance_decode())
+            events.extend(self._advance_spec_decode()
+                          if self.spec is not None
+                          else self._advance_decode())
         return events
+
+    def _register_prefix_blocks(self, s: int) -> None:
+        """Publish the full prompt blocks slot ``s``'s prefill has now
+        written (or shares) into the prefix cache, so later admissions
+        with the identical prefix can map them. First writer wins; an
+        entry for the same prefix already present (the donor, or a
+        concurrent identical prompt that couldn't share yet) is kept."""
+        slot = self.slots[s]
+        bl = self.paged.block_len
+        while ((slot.registered + 1) * bl <= slot.prefill_off
+               and (slot.registered + 1) * bl <= len(slot.prompt)):
+            n = slot.registered + 1
+            key = slot.prompt_key[:n * bl]
+            block = int(self.tables[s, n - 1])
+            if key not in self._prefix_blocks:
+                self._prefix_blocks[key] = block
+                self._block_key[block] = key
+            slot.registered = n
 
     def _advance_prefill(self, s: int) -> List[TokenEvent]:
         slot = self.slots[s]
@@ -485,12 +660,31 @@ class Engine:
         chunk = np.zeros(tc, np.int32)
         chunk[:n_valid] = slot.prompt[off:off + n_valid]
         is_final = off + n_valid >= len(slot.prompt)
+        write_from = slot.shared * self.paged.block_len
+        table_row = jnp.array(self.tables[s])
+        chunk_j = jnp.array(chunk)
         self.pool, tok, new_key = self._prefill(
             self.pool, self.params, self.fused,
-            jnp.array(self.tables[s]), jnp.array(chunk),
-            jnp.int32(off), jnp.int32(n_valid),
+            table_row, chunk_j,
+            jnp.int32(off), jnp.int32(n_valid), jnp.int32(write_from),
             self.keys[s], jnp.float32(self.temps[s]))
+        if self.draft is not None:
+            # Mirror the chunk into the draft pool (same table row, same
+            # positions, the draft's weights) so proposals can attend over
+            # the full prompt. Shared blocks are shared there too — the
+            # donor's draft prefill wrote them — so the same write_from
+            # masking applies.
+            self.draft.prefill_chunk(table_row, chunk_j, jnp.int32(off),
+                                     jnp.int32(n_valid),
+                                     jnp.int32(write_from), self.temps[s])
+            # The mirror is a real draft dispatch: without it the JSON's
+            # draft-cost line under-reports by one dispatch per prefill
+            # chunk (~15% on the CI smoke's workload) and a real small
+            # draft sized from it would look cheaper than it is.
+            self.draft_dispatches += 1
         slot.prefill_off = off + n_valid
+        if self.prefix_share:
+            self._register_prefix_blocks(s)
         if not is_final:
             # Intermediate chunk: K/V written; the sampled token and split
             # key are discarded so the slot's RNG stream stays exactly
@@ -507,12 +701,40 @@ class Engine:
             self._retire(s)
         return [TokenEvent(s, first, first=True, done=done)]
 
+    def _gathered_tables(self, active: np.ndarray, tq: int) -> np.ndarray:
+        """The block-table slice a decode/verify dispatch gathers through.
+        Full width by default; with ``gather_buckets``, narrowed to the
+        smallest bucket covering every active slot's LIVE blocks (reads
+        reach positions < pos + tq, all ≤ the slot's written-or-writing
+        frontier), with the avoided gather traffic counted analytically
+        — the decode table's KV read line in ROOFLINE.md is per live
+        position, and this is the knob that makes the gather live-length
+        instead of worst-case."""
+        bl, mb = self.paged.block_len, self.paged.max_blocks_per_seq
+        from .kvcache import kv_bytes_per_token
+        per_block = bl * kv_bytes_per_token(self.cfg, self.paged.kv_dtype)
+        if not self.gather_buckets:
+            self.gather_bytes += self.num_slots * mb * per_block
+            return self.tables
+        need = 1
+        for s in np.nonzero(active)[0]:
+            need = max(need, -(-(int(self.pos[s]) + tq) // bl))
+        # A verify window near the horizon can ask past the table (pos +
+        # k + 1 spills over a full-width reservation); the overflow rows
+        # are live-masked to trash in-program and the blk_idx clamp tops
+        # out at the table width, so the host need caps at mb.
+        cols = next(b for b in self._buckets if b >= min(need, mb))
+        self.gather_bytes += self.num_slots * cols * per_block
+        self.gather_bytes_saved += self.num_slots * (mb - cols) * per_block
+        return self.tables[:, :cols]
+
     def _advance_decode(self) -> List[TokenEvent]:
         active = np.array([sl is not None and sl.phase == "decode"
                            for sl in self.slots])
+        tables = self._gathered_tables(active, 1)
         self.pool, toks, new_keys = self._decode(
             self.pool, self.params, self.fused,
-            jnp.array(self.tables), jnp.array(self.last_tok),
+            jnp.array(tables), jnp.array(self.last_tok),
             jnp.array(self.pos), self.keys,
             jnp.array(self.temps), jnp.array(active))
         toks = np.asarray(toks)
@@ -528,6 +750,70 @@ class Engine:
             if done:
                 self._retire(s)
             events.append(TokenEvent(int(s), tok, first=False, done=done))
+        self.decode_dispatches += 1
+        self.decode_tokens += len(events)
+        return events
+
+    def _advance_spec_decode(self) -> List[TokenEvent]:
+        """One speculative round (serving/speculate.py): k draft decode
+        dispatches propose, one cache-fill dispatch keeps the draft pool
+        whole, ONE target verify dispatch scores all k+1 window positions
+        and accepts a prefix. Emits ``min(accepted + 1, remaining)``
+        tokens per active slot — the greedy ones bitwise ``generate()``'s
+        — and records the round's proposal accounting in ``last_spec``
+        (the scheduler's ``speculate`` event, schema v7)."""
+        k = self.spec.k
+        active_l = [sl is not None and sl.phase == "decode"
+                    for sl in self.slots]
+        active = np.array(active_l)
+        remaining = np.array([sl.max_new - sl.produced if a else 0
+                              for a, sl in zip(active_l, self.slots)],
+                             np.int32)
+        live = np.minimum(k + 1, np.maximum(remaining, 1)).astype(np.int32)
+        tables = jnp.array(self._gathered_tables(active, k + 1))
+        pos = jnp.array(self.pos)
+        temps = jnp.array(self.temps)
+        active_j = jnp.array(active)
+        live_j = jnp.array(live)
+        drafts, draft_probs = self.draft.propose(
+            tables, jnp.array(self.last_tok), pos, temps, active_j, live_j)
+        self.draft_dispatches += k + 1
+        window = jnp.concatenate([jnp.array(self.last_tok)[:, None],
+                                  drafts], axis=1)
+        self.pool, out, accepted, new_keys = self._verify(
+            self.pool, self.params, self.fused, tables, window,
+            draft_probs, pos, live_j, self.keys, temps, active_j)
+        out = np.asarray(out)
+        accepted = np.asarray(accepted)
+        self.keys = new_keys
+        self.decode_dispatches += 1
+        events: List[TokenEvent] = []
+        n_active = int(active.sum())
+        used = proposed = 0
+        for s in np.nonzero(active)[0]:
+            slot = self.slots[s]
+            emit = min(int(accepted[s]) + 1, int(remaining[s]))
+            # The draft really proposed min(k, remaining) tokens for this
+            # slot — the propose loop masks rows past the live window, so
+            # horizon truncation is not a draft failure and must not read
+            # as rejection in the acceptance rate.
+            proposed += min(k, int(remaining[s]))
+            used += min(int(accepted[s]), emit)
+            for i in range(emit):
+                tok = int(out[s, i])
+                slot.produced += 1
+                self.pos[s] += 1
+                self.last_tok[s] = tok
+                done = slot.produced >= slot.max_new
+                if done:
+                    self._retire(s)
+                events.append(TokenEvent(int(s), tok, first=False,
+                                         done=done))
+        self.decode_tokens += len(events)
+        self.last_spec = {"k": k, "slots": n_active,
+                          "proposed": proposed, "accepted": used,
+                          "rejected": proposed - used,
+                          "emitted": len(events)}
         return events
 
     def retire(self, s: int) -> None:
@@ -543,8 +829,16 @@ class Engine:
 
     def _retire(self, s: int) -> None:
         """Free the slot and its blocks IMMEDIATELY (the continuous-batching
-        point: the next token boundary can re-use them)."""
-        self.allocator.free(self.slots[s].blocks)
+        point: the next token boundary can re-use them). Under CoW the
+        free is a refcount decrement for shared blocks; blocks that
+        actually return to the pool lose their prefix-cache entries (a
+        later admission must never map a block the allocator may have
+        re-granted)."""
+        freed = self.allocator.free(self.slots[s].blocks)
+        for b in freed:
+            key = self._block_key.pop(b, None)
+            if key is not None:
+                self._prefix_blocks.pop(key, None)
         self.slots[s] = None
         self.tables[s] = TRASH_BLOCK
         self.pos[s] = 0

@@ -151,16 +151,25 @@ class ServingFleet:
                  events: Optional[EventLog] = None,
                  token_events: bool = True,
                  policy: str = "least_loaded", window_s: float = 30.0,
-                 admission: str = "fcfs",
+                 admission: str = "fcfs", speculate=None,
+                 prefix_share: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         if num_engines < 1:
             raise ValueError(f"num_engines={num_engines}")
         self.cfg = cfg
         self.paged = paged
         self.clock = clock
+        # ``speculate`` (serving/speculate.py SpecConfig) arms EVERY
+        # engine with the draft + verify programs — per-engine draft
+        # pools, like per-engine block pools. ``prefix_share`` likewise
+        # (prefix caches are per engine: blocks are physical pool
+        # indices, so sharing cannot cross engines — the routing seam
+        # ROADMAP 1b's prefix-affinity policy will exploit).
         self.engines = [Engine(params, cfg, paged, num_slots,
                                prefill_chunk=prefill_chunk, top_k=top_k,
-                               top_p=top_p, engine_id=i)
+                               top_p=top_p, engine_id=i,
+                               speculate=speculate,
+                               prefix_share=prefix_share)
                         for i in range(num_engines)]
         self.scheds = [Scheduler(eng, events=events,
                                  token_events=token_events, clock=clock,
@@ -252,11 +261,11 @@ class ServingFleet:
         return sum(s.completed for s in self.scheds)
 
     def compiles(self) -> List[int]:
-        return [len(e._prefill.compiles) + len(e._decode.compiles)
+        return [sum(len(w.compiles) for w in e.watches())
                 for e in self.engines]
 
     def retraces(self) -> List[int]:
-        return [e._prefill.retraces + e._decode.retraces
+        return [sum(w.retraces for w in e.watches())
                 for e in self.engines]
 
 
@@ -290,7 +299,8 @@ def run_serving_fleet(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                       events: Optional[EventLog] = None,
                       token_events: bool = True,
                       policy: str = "least_loaded", window_s: float = 30.0,
-                      admission: str = "fcfs",
+                      admission: str = "fcfs", speculate=None,
+                      prefix_share: bool = False,
                       publish_after: Optional[int] = None,
                       publish_params: Optional[dict] = None,
                       publish_version=None) -> FleetReport:
@@ -308,6 +318,7 @@ def run_serving_fleet(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                          top_k=top_k, top_p=top_p, events=events,
                          token_events=token_events, policy=policy,
                          window_s=window_s, admission=admission,
+                         speculate=speculate, prefix_share=prefix_share,
                          clock=clock.now)
     pending = sorted(workload, key=lambda r: (r.arrival, r.rid))
     published = publish_after is None

@@ -199,10 +199,31 @@ class ServingReport:
     peak_concurrency: int = 0
     requests: List[Request] = field(default_factory=list)
     # Compile/retrace accounting (telemetry/introspect.py CompileWatch on
-    # the engine's two programs): the engine's contract is compiles == 2
-    # and retraces == 0 for ANY workload — raggedness is data, not shapes.
+    # the engine's program set): the contract is compiles == the
+    # documented set (2 plain; 4 with speculation — prefill + verify +
+    # the draft's two, decode_step idling; gather narrowing adds one per
+    # extra bucket width actually hit) and retraces == 0 for ANY
+    # workload — raggedness is data, not shapes.
     compiles: int = 0
     retraces: int = 0
+    # Speculative decoding accounting (serving/speculate.py): target
+    # decode dispatches (verify dispatches when speculating), tokens they
+    # emitted, and the draft's (cheap) dispatch count. tokens_per_dispatch
+    # = decode_tokens / decode_dispatches — the dispatch-bound hosts'
+    # headline (ROOFLINE.md "speculative decode" row); ≈1×avg-batch
+    # without speculation, ×(accepted+1) with it.
+    decode_dispatches: int = 0
+    decode_tokens: int = 0
+    draft_dispatches: int = 0
+    tokens_per_dispatch: Optional[float] = None
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    acceptance_rate: Optional[float] = None
+    # Gather-narrowing accounting (Engine(gather_buckets=True)): KV bytes
+    # the decode/verify gathers walked, and the bytes the full
+    # max_blocks_per_seq walk would have added on top.
+    gather_bytes: int = 0
+    gather_bytes_saved: int = 0
 
 
 def aggregate_latency(records: Dict[str, RequestRecord],
@@ -257,14 +278,21 @@ def run_serving(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                 prefill_chunk: int = 16, top_k: Optional[int] = None,
                 top_p: Optional[float] = None,
                 events: Optional[EventLog] = None,
-                token_events: bool = True) -> ServingReport:
+                token_events: bool = True,
+                speculate=None, prefix_share: bool = False,
+                gather_buckets: bool = False) -> ServingReport:
     """Replay ``workload`` (arrival offsets in seconds) through a fresh
     engine + scheduler; returns per-request records and the aggregate row.
     Every request is guaranteed retired on return — reservation-based
     admission cannot deadlock (scheduler.py), so the loop's only exit is
-    completion."""
+    completion. ``speculate`` (a ``SpecConfig``) turns on draft-propose /
+    one-dispatch-verify decoding; ``prefix_share`` maps identical
+    full-block prompt prefixes copy-on-write; ``gather_buckets`` narrows
+    the decode gather to bucketed live-block counts."""
     engine = Engine(params, cfg, paged, num_slots,
-                    prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p)
+                    prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p,
+                    speculate=speculate, prefix_share=prefix_share,
+                    gather_buckets=gather_buckets)
     clock = _Clock()
     sched = Scheduler(engine, events=events, token_events=token_events,
                       clock=clock.now)
@@ -282,18 +310,29 @@ def run_serving(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
         sched.tick()
         busy_s += clock.now() - now
     peak_conc = sched.peak_in_flight   # recorded at admission (scheduler.py)
+    spec_prop = sum(e.get("proposed", 0) for e in sched.spec_rounds)
+    spec_acc = sum(e.get("accepted", 0) for e in sched.spec_rounds)
     report = ServingReport(
         records=sched.records,
         aggregates=aggregate_latency(sched.records, busy_span_s=busy_s),
         wall_s=clock.now(),
         peak_blocks_in_use=engine.allocator.peak_in_use,
         pool_blocks=engine.allocator.capacity,
-        compiles=(len(engine._prefill.compiles)
-                  + len(engine._decode.compiles)),
-        retraces=engine._prefill.retraces + engine._decode.retraces,
+        compiles=sum(len(w.compiles) for w in engine.watches()),
+        retraces=sum(w.retraces for w in engine.watches()),
         pool_bytes=pool_bytes(cfg, paged),
         naive_bytes_at_peak=naive_cache_bytes(
             cfg, max(1, peak_conc), paged.max_seq_len, paged.kv_dtype),
         peak_concurrency=peak_conc,
-        requests=list(workload))
+        requests=list(workload),
+        decode_dispatches=engine.decode_dispatches,
+        decode_tokens=engine.decode_tokens,
+        draft_dispatches=engine.draft_dispatches,
+        tokens_per_dispatch=(engine.decode_tokens / engine.decode_dispatches
+                             if engine.decode_dispatches else None),
+        spec_proposed=spec_prop,
+        spec_accepted=spec_acc,
+        acceptance_rate=(spec_acc / spec_prop if spec_prop else None),
+        gather_bytes=engine.gather_bytes,
+        gather_bytes_saved=engine.gather_bytes_saved)
     return report

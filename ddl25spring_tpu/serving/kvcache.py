@@ -114,7 +114,8 @@ def naive_cache_bytes(cfg: LlamaConfig, n_streams: int, max_len: int,
 
 
 class BlockAllocator:
-    """Host-side free list over block indices ``1..num_blocks-1``.
+    """Host-side free list over block indices ``1..num_blocks-1``, with
+    per-block REFERENCE COUNTS for copy-on-write prefix sharing.
 
     ``alloc`` is all-or-nothing (a sequence's full reservation or None) so
     admission control can never strand a half-provisioned request — the
@@ -122,6 +123,16 @@ class BlockAllocator:
     hand-out keeps runs reproducible; block identity never reaches the
     math (attention gathers through the table), so the order is a
     debugging nicety, not a correctness requirement.
+
+    Sharing (ROADMAP 2c): ``share`` takes additional references on
+    already-allocated blocks — requests whose prompts share a full-block
+    prefix map the SAME physical blocks read-only (the engine masks their
+    writes to trash), so N identical prefixes cost one block set plus
+    refcounts instead of N. ``free`` decrements and returns a block to
+    the free list only at zero — and reports which blocks PHYSICALLY
+    freed, so the engine can evict their prefix-cache entries. ``in_use``
+    and ``peak_in_use`` count physical blocks: the peak DROPPING on a
+    shared-prefix workload is the satellite's acceptance bar.
     """
 
     def __init__(self, num_blocks: int):
@@ -129,6 +140,7 @@ class BlockAllocator:
             raise ValueError(f"num_blocks={num_blocks}: nothing to allocate")
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, 0, -1))   # pop() -> lowest
+        self._refs: dict = {}            # block -> live references
         self.peak_in_use = 0
 
     @property
@@ -143,6 +155,9 @@ class BlockAllocator:
     def in_use(self) -> int:
         return self.capacity - len(self._free)
 
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` blocks, or None if the pool cannot cover them (caller
         queues — never a partial grant)."""
@@ -151,16 +166,43 @@ class BlockAllocator:
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
+        for b in got:
+            self._refs[b] = 1
         self.peak_in_use = max(self.peak_in_use, self.in_use)
         return got
 
-    def free(self, blocks: List[int]) -> None:
+    def share(self, blocks: List[int]) -> None:
+        """Take one more reference on each (already-allocated) block —
+        the CoW mapping step. Never touches the free list, so it can
+        never fail for capacity and never moves the physical peak."""
+        for b in blocks:
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"share({b}): block is not allocated")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def free(self, blocks: List[int]) -> List[int]:
+        """Drop one reference per block; blocks reaching zero return to
+        the free list. Returns the PHYSICALLY freed blocks (refcount hit
+        zero) so prefix-cache entries can be evicted with them."""
         for b in blocks:
             if not 1 <= b < self.num_blocks:
                 raise ValueError(f"free({b}): not an allocatable block")
-            if b in self._free:
+        counts: dict = {}
+        for b in blocks:
+            counts[b] = counts.get(b, 0) + 1
+        for b, n in counts.items():
+            if self._refs.get(b, 0) < n:
                 raise ValueError(f"free({b}): double free")
+        freed = []
+        for b, n in counts.items():
+            self._refs[b] -= n
+            if self._refs[b] == 0:
+                del self._refs[b]
+                freed.append(b)
         # Re-sort so the free list stays lowest-first regardless of
         # retirement order — allocation traces depend only on the
         # alloc/free sequence, not on which request finished first.
-        self._free = sorted(set(self._free) | set(blocks), reverse=True)
+        if freed:
+            self._free = sorted(set(self._free) | set(freed), reverse=True)
+        return freed

@@ -175,14 +175,20 @@ class Scheduler:
         # retirement, drained by Router.harvest — bounded by whoever
         # consumes it, same O(requests) order as ``records`` without one.
         self.recent_done: List[Tuple[float, Optional[float]]] = []
+        # Per-verify-dispatch speculation accounting (engine.last_spec
+        # snapshots) — the host-side twin of the schema-v7 ``speculate``
+        # events, kept even with no event stream so ServingReport can
+        # compute acceptance/tokens-per-dispatch either way.
+        self.spec_rounds: List[dict] = []
         if events is not None:
             # Late-bind the stream to the engine's compile watches: the
-            # engine is built before any telemetry exists, but its two
-            # compilations (and any retrace — a budget violation) should
-            # land in THIS scheduler's event stream.
+            # engine is built before any telemetry exists, but its
+            # compilations (two programs plain, five with speculation —
+            # and any retrace, a budget violation) should land in THIS
+            # scheduler's event stream.
             from ..telemetry.introspect import bind_events
-            bind_events(engine._prefill, events)
-            bind_events(engine._decode, events)
+            for w in engine.watches():
+                bind_events(w, events)
         # Per-request trace trees ride the scheduler's OWN clock (the load
         # harness fast-forwards it through idle gaps), so span timestamps
         # and the queue_wait_s/ttft_s latency fields share one timebase.
@@ -264,6 +270,7 @@ class Scheduler:
         for _, s in chunk_spans:
             s.end()
         eos_retired: set = set()
+        eos_dropped = 0
         for ev in events:
             if ev.slot in eos_retired:
                 # The slot EOS-retired earlier THIS tick (engine.step can
@@ -273,6 +280,7 @@ class Scheduler:
                 # this tick's EOS retirements only, so an event for a
                 # slot the scheduler genuinely doesn't own still raises
                 # (a dropped-token bug must stay loud).
+                eos_dropped += 1
                 continue
             req = self._by_slot[ev.slot]
             rec = self.records[req.rid]
@@ -301,7 +309,14 @@ class Scheduler:
                 # capacity decision: the emitted stream is generate()'s
                 # stream truncated at the first EOS (the engine never fed
                 # the EOS back, so nothing downstream of it ever existed).
-                self.engine.retire(ev.slot)
+                # Under speculation one verify window can BOTH emit the
+                # EOS mid-window and reach max_new at its last row — the
+                # engine then already self-retired the slot while
+                # emitting the tail this loop is about to drop, so the
+                # explicit retire is conditional on the slot still being
+                # live (blocks are back in the pool either way).
+                if self.engine.slots[ev.slot] is not None:
+                    self.engine.retire(ev.slot)
                 eos_retired.add(ev.slot)
                 done = early_eos = True
             if done:
@@ -334,6 +349,27 @@ class Scheduler:
                         tenant=req.tenant, **self._tag,
                         **({"eos": True} if early_eos else {}))
             emitted.append((req.rid, ev.token))
+        if self.engine.last_spec is not None:
+            # One ``speculate`` event per verify dispatch (schema v7):
+            # the round's proposed/accepted/rejected counts — the
+            # acceptance-rate and tokens-per-dispatch feed for obs_report
+            # and slo_monitor's acceptance floor. Emitted AFTER the event
+            # loop so ``emitted`` counts tokens actually DELIVERED: a
+            # mid-window EOS drops the window tail above, and those
+            # tokens must not inflate tokens-per-dispatch (the CI 2× bar
+            # measures delivered throughput). proposed/accepted/rejected
+            # stay verify-outcome accounting — EOS truncation is not a
+            # draft failure, so the acceptance floor never sees it.
+            spec = self.engine.last_spec
+            if eos_dropped:
+                spec = {**spec, "emitted": spec["emitted"] - eos_dropped}
+            self.spec_rounds.append(spec)
+            if self.events:
+                self.events.speculate(**spec, **self._tag)
+        if eos_dropped:
+            # Keep the report's token count (ServingReport.decode_tokens
+            # → tokens_per_dispatch) on the same delivered basis.
+            self.engine.decode_tokens -= eos_dropped
         return emitted
 
     # ---------------------------------------------------------- weight swap
@@ -345,7 +381,14 @@ class Scheduler:
         changes, nothing recompiles (``Engine.swap_params`` enforces the
         equal-tree contract). Emits a ``deploy`` event + span (schema
         v6) carrying the publication ``version`` and how many streams
-        crossed the swap live."""
+        crossed the swap live.
+
+        With speculation on, a tick is one whole draft-propose + verify
+        round, so a swap between ticks necessarily lands at a VERIFY
+        boundary: a round's proposals and its verification always run
+        under one generation of target weights — draft and target never
+        mix generations mid-window. (The draft keeps its own weights; a
+        stale draft can only lower acceptance, never correctness.)"""
         span = (self.tracer.start("deploy", trace=f"deploy-{version}",
                                   version=version,
                                   in_flight=len(self._by_slot),
@@ -369,12 +412,14 @@ class Scheduler:
         top = max(r.priority for r in self.queue)
         group = [i for i, r in enumerate(self.queue) if r.priority == top]
         head = self.queue[group[0]]
-        if self.engine.can_admit(len(head.prompt), head.max_new):
+        if self.engine.can_admit(len(head.prompt), head.max_new,
+                                 prompt=head.prompt):
             return group[0]
         if self.policy == "sjf" and self.engine.free_slot() is not None:
             fitting = [i for i in group
                        if self.engine.can_admit(len(self.queue[i].prompt),
-                                                self.queue[i].max_new)]
+                                                self.queue[i].max_new,
+                                                prompt=self.queue[i].prompt)]
             if fitting:
                 return min(fitting,
                            key=lambda i: (self.records[self.queue[i].rid]

@@ -56,10 +56,15 @@ from typing import Any, Dict, Iterator, List, Optional
 # across the swap); ``request_*`` events additionally carry ``engine``
 # (the serving engine id) and ``tenant`` (the traffic class) when emitted
 # by a fleet scheduler — extras, so single-engine v2 streams stay valid.
-# Version bumps are additive: a v6 reader accepts v1–v5 streams
-# unchanged, and older readers reject v6 (the "future schema" rule in
+# v7: speculative decoding (serving/speculate.py) — ``speculate`` (one
+# draft-propose + verify round: proposed/accepted/rejected draft-token
+# counts and tokens emitted by the ONE verify dispatch — the
+# acceptance-rate and tokens-per-dispatch accounting obs_report renders
+# and slo_monitor's acceptance floor watches).
+# Version bumps are additive: a v7 reader accepts v1–v6 streams
+# unchanged, and older readers reject v7 (the "future schema" rule in
 # validate_event) rather than misread it.
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # Event types this schema version defines. The type set is CLOSED per
 # schema version: ``validate_event`` checks base fields for all types, the
@@ -70,7 +75,8 @@ SCHEMA_VERSION = 6
 EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
                "request_done", "fl_cohort", "fl_tier", "span",
-               "slo_violation", "numerics", "compile", "route", "deploy")
+               "slo_violation", "numerics", "compile", "route", "deploy",
+               "speculate")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -140,6 +146,15 @@ _REQUIRED: Dict[str, tuple] = {
     # timeline.
     "route": ("req", "engine"),
     "deploy": ("version",),
+    # Speculative decoding (serving/speculate.py + scheduler.py, schema
+    # v7): one event per verify dispatch — ``proposed`` draft tokens this
+    # round (k × active slots), ``accepted`` of them re-derived by the
+    # target; extras carry ``rejected``, ``emitted`` (tokens the dispatch
+    # DELIVERED: accepted + one correction/bonus per slot, minus any
+    # window tail dropped after a mid-window EOS), ``k``, ``slots``
+    # and ``engine``. acceptance = accepted/proposed; tokens-per-dispatch
+    # = emitted per event (one verify dispatch each).
+    "speculate": ("proposed", "accepted"),
     # Compile/retrace accounting (introspect.CompileWatch, schema v5):
     # one event per XLA compilation of a watched jit entry point —
     # ``name`` the factory label, ``seconds`` the compiling call's wall
@@ -364,6 +379,13 @@ class EventLog:
 
     def deploy(self, *, version, **fields) -> Dict[str, Any]:
         return self.emit("deploy", version=version, **fields)
+
+    # Speculative decoding (schema v7; serving/scheduler.py emits one per
+    # verify dispatch).
+    def speculate(self, *, proposed: int, accepted: int,
+                  **fields) -> Dict[str, Any]:
+        return self.emit("speculate", proposed=proposed, accepted=accepted,
+                         **fields)
 
     def close(self) -> None:
         with self._lock:

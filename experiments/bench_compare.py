@@ -45,12 +45,14 @@ from typing import Any, Dict, List, Optional, Tuple
 DERIVED_FIELDS = ("mfu", "attainment")
 
 # Direction map. Most headline rows are throughput-like (higher is
-# better), but the comm-wire smoke's byte rows regress UPWARD — more
-# bytes is worse — and judging them higher-is-better would wave a
-# wire-bytes regression through as an "improvement". A metric whose name
-# starts with one of these prefixes is compared against the best (LOWEST)
-# committed row and gates when the candidate rises above it by more than
-# the budget.
+# better) — that default covers ``tokens_per_dispatch`` (the serving
+# bench's speculative-decode row: MORE tokens per target dispatch is the
+# win, so a draft regression gates like a tok/s drop) — but the
+# comm-wire smoke's byte rows regress UPWARD — more bytes is worse — and
+# judging them higher-is-better would wave a wire-bytes regression
+# through as an "improvement". A metric whose name starts with one of
+# these prefixes is compared against the best (LOWEST) committed row and
+# gates when the candidate rises above it by more than the budget.
 LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes")
 
 

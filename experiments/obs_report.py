@@ -200,6 +200,27 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                 mine = [e for e in req_done if e.get("tenant") == cls]
                 print(f"class {cls}: {len(mine)} done")
                 _latency_lines(mine, indent="  ")
+        specs = by_type.get("speculate", [])
+        if specs:
+            # Speculative decoding (schema v7, serving/speculate.py): one
+            # event per verify dispatch. Acceptance = accepted/proposed
+            # draft tokens; tokens-per-dispatch = tokens the target's
+            # verify dispatches landed (the dispatch-bound decode
+            # headline) — a rate near 1/(k+1) of the emitted window means
+            # the draft is degenerate (slo_monitor's acceptance floor).
+            prop = sum(e.get("proposed", 0) for e in specs)
+            acc = sum(e.get("accepted", 0) for e in specs)
+            emitted = sum(e.get("emitted", 0) for e in specs
+                          if isinstance(e.get("emitted"), int))
+            ks = sorted({e.get("k") for e in specs
+                         if isinstance(e.get("k"), int)})
+            line = (f"speculate: {len(specs)} verify dispatches"
+                    + (f"   k={'/'.join(map(str, ks))}" if ks else ""))
+            if prop:
+                line += f"   acceptance {acc}/{prop} = {acc / prop:.3f}"
+            if emitted:
+                line += f"   tokens/dispatch {emitted / len(specs):.2f}"
+            print(line)
 
     routes = by_type.get("route", [])
     deploys = by_type.get("deploy", [])

@@ -161,6 +161,13 @@ class SLOConfig:
     min_mfu: Optional[float] = None
     max_gradnorm_spike_rate: Optional[float] = None
     gradnorm_spike_factor: float = 10.0
+    # Speculative-decoding acceptance floor (schema v7 ``speculate``
+    # events): accepted/proposed draft tokens over the window. A
+    # degenerate draft decays acceptance toward 0 (at the tokens-per-
+    # dispatch level, toward 1/(k+1) of the window) — a THROUGHPUT
+    # regression the tok/s floor may not catch on a lightly-loaded
+    # fleet, so it is its own objective, not a silent slowdown.
+    min_acceptance_rate: Optional[float] = None
     # Per-traffic-class objectives (schema v6 ``tenant`` tags):
     # {class: {"ttft_p99_s": s, "queue_p99_s": s}} — the
     # serving.frontend.class_slos shape. Violations are keyed
@@ -197,6 +204,7 @@ class SLOMonitor:
         # and last-compile-wins cannot skew the floor.
         self._dts: deque = deque()      # (t, steps, dt_s)
         self._gradnorms: deque = deque()  # (t, grad_norm)
+        self._spec: deque = deque()     # (t, proposed, accepted)
         self._flops_per_step: Optional[float] = None
         self._peak_flops: Optional[float] = None
         # Per-class rolling windows (one ttft + one wait deque per class
@@ -279,6 +287,11 @@ class SLOMonitor:
             elif etype == "numerics":
                 if isinstance(e.get("grad_norm"), (int, float)):
                     self._gradnorms.append((t, e["grad_norm"]))
+            elif etype == "speculate":
+                if (isinstance(e.get("proposed"), int)
+                        and isinstance(e.get("accepted"), int)
+                        and e["proposed"] > 0):
+                    self._spec.append((t, e["proposed"], e["accepted"]))
             elif etype == "run_end":
                 self.run_ended = True
 
@@ -335,7 +348,7 @@ class SLOMonitor:
     def _prune(self, now: float) -> None:
         horizon = now - self.cfg.window_s
         for dq in (self._ttft, self._wait, self._tokens, self._skips,
-                   self._steps, self._dts, self._gradnorms,
+                   self._steps, self._dts, self._gradnorms, self._spec,
                    *self._cls_ttft.values(), *self._cls_wait.values()):
             while dq and dq[0][0] < horizon:
                 dq.popleft()
@@ -416,6 +429,18 @@ class SLOMonitor:
                 if v > cfg.max_gradnorm_spike_rate:
                     measured["gradnorm_spike_rate"] = (
                         v, cfg.max_gradnorm_spike_rate)
+        if cfg.min_acceptance_rate is not None and self._spec:
+            # Windowed acceptance over verify dispatches. Idle (no
+            # speculate events in the window) is not a breach — same
+            # posture as the latency objectives; a DEGENERATE draft keeps
+            # proposing and failing, which is exactly what lands here.
+            prop = sum(p for _, p, _ in self._spec)
+            acc = sum(a for _, _, a in self._spec)
+            if prop > 0:
+                v = acc / prop
+                if v < cfg.min_acceptance_rate:
+                    measured["spec_acceptance_rate"] = (
+                        v, cfg.min_acceptance_rate)
         if cfg.max_skip_rate is not None and self._skips:
             steps = sum(n for _, n in self._steps)
             skips = sum(n for _, n in self._skips)
@@ -533,6 +558,11 @@ def main(argv=None) -> int:
                     help="MFU floor over the window (achieved FLOP/s from "
                          "compile-event flops + step timing, vs the "
                          "manifest's roofline peaks)")
+    ap.add_argument("--slo-acceptance", type=float, default=None,
+                    help="speculative-decoding acceptance-rate floor over "
+                         "the window (accepted/proposed draft tokens from "
+                         "schema-v7 speculate events; a degenerate draft "
+                         "is an SLO breach, not a silent slowdown)")
     ap.add_argument("--slo-gradnorm", type=float, default=None,
                     help="grad-norm spike-rate ceiling (fraction of the "
                          "window's numerics samples above "
@@ -577,6 +607,7 @@ def main(argv=None) -> int:
                     min_mfu=a.slo_mfu,
                     max_gradnorm_spike_rate=a.slo_gradnorm,
                     gradnorm_spike_factor=a.gradnorm_factor,
+                    min_acceptance_rate=a.slo_acceptance,
                     per_class=per_class)
     emit_default = not a.check
     emit = a.emit if a.emit is not None else emit_default

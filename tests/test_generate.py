@@ -183,14 +183,15 @@ def _paged():
 
 
 def _engine_streams(params, requests, *, num_slots, prefill_chunk,
-                    top_k=None, top_p=None):
+                    top_k=None, top_p=None, speculate=None):
     """Run ragged ``(prompt, max_new, temperature, seed)`` requests in ONE
     slot batch; returns each slot's emitted tokens."""
     import numpy as np
 
     from ddl25spring_tpu.serving import Engine
     eng = Engine(params, CFG, _paged(), num_slots,
-                 prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p)
+                 prefill_chunk=prefill_chunk, top_k=top_k, top_p=top_p,
+                 speculate=speculate)
     slots = {}
     for i, (prompt, mx, temp, seed) in enumerate(requests):
         key = jax.random.PRNGKey(seed) if temp > 0 else None
@@ -268,6 +269,96 @@ def test_slot_engine_matches_generate_with_top_k_top_p(params):
     for (prompt, mx, temp, seed), stream in zip(reqs, got):
         assert stream == _generate_stream(params, prompt, mx, temp, seed,
                                           top_k=7, top_p=0.9)
+
+
+# -------------------------------------------------- speculative decoding
+# Greedy speculative decoding must emit BITWISE the greedy stream: every
+# accepted draft token is re-derived as the target's own argmax, and so
+# is the correction/bonus token beyond the accepted prefix — for ANY
+# draft, at any k (serving/speculate.py; the engine battery's scheduler-
+# level and CoW twins live in tests/test_speculate.py).
+
+def _spec(params_or_draft, k):
+    from ddl25spring_tpu.serving import SpecConfig
+    return SpecConfig(k=k, draft_params=params_or_draft)
+
+
+def test_reference_speculative_stream_matches_generate(params):
+    """The hand-checkable reference (models/generate.py): greedy
+    draft-propose/verify over full re-forwards equals generate() token
+    for token at k ∈ {1, 3} — for a same-weights draft (acceptance 1,
+    every proposal used) AND a disagreeing one (acceptance < 1, every
+    correction used)."""
+    draft = llama.init_llama(jax.random.PRNGKey(9), CFG)
+    prompt = [3, 5, 7, 2]
+    want = generate.generate(params, jnp.asarray([prompt]), CFG,
+                             7)[0].tolist()
+    for k in (1, 3):
+        for dp in (params, draft):
+            got, stats = generate.speculative_stream(params, dp, prompt,
+                                                     CFG, 7, k=k)
+            assert got == want, (k, stats)
+            assert stats["proposed"] > 0
+            assert 0 <= stats["accepted"] <= stats["proposed"]
+    # Same weights accept every usable proposal; the acceptance counter
+    # is exact, not an estimate — INCLUDING at a max_new that is not a
+    # multiple of the round size, where the final round's proposals are
+    # horizon-truncated: only min(k, remaining) count as proposed (the
+    # engine's schema-v7 rule), so truncation never reads as rejection.
+    for mx in (7, 6):
+        _, s_same = generate.speculative_stream(params, params, prompt,
+                                                CFG, mx, k=3)
+        assert s_same["accepted"] == s_same["proposed"] > 0, mx
+
+
+def test_slot_engine_speculative_greedy_bitwise(params):
+    """Ragged greedy prompts in one slot batch under speculation: each
+    stream bitwise generate()'s for k ∈ {1, 3}, with a same-weights and
+    a separately-weighted draft — acceptance rate is a throughput knob,
+    never a token knob."""
+    draft = llama.init_llama(jax.random.PRNGKey(9), CFG)
+    reqs = []
+    rng = jax.random.PRNGKey(23)
+    for tp, mx in [(3, 6), (9, 4), (5, 8)]:
+        rng, sub = jax.random.split(rng)
+        prompt = jax.random.randint(sub, (tp,), 0, CFG.vocab_size).tolist()
+        reqs.append((prompt, mx, 0.0, 0))
+    want = [_generate_stream(params, p, mx, t, s) for p, mx, t, s in reqs]
+    for k in (1, 3):
+        for dp in (params, draft):
+            got = _engine_streams(params, reqs, num_slots=3,
+                                  prefill_chunk=4, speculate=_spec(dp, k))
+            assert got == want, k
+
+
+def test_speculative_acceptance_straddles_block_edge(params):
+    """Verify windows whose accepted prefix crosses a block boundary
+    (block_len=4; prompt lengths chosen so windows start mid-block and
+    end in the next) write the straddling K/V correctly: streams stay
+    bitwise through every crossing, including a max_seq_len request
+    whose final window is horizon-clamped (the live mask — an unmasked
+    tail write would wrap onto the slot's own last block)."""
+    reqs = [([1, 2, 3], 10, 0.0, 0),       # windows at pos 3,7,11,...
+            ([5, 6, 7, 8, 9, 10], 8, 0.0, 0),
+            # 24+8-1 = 31 positions: the full 8-block reservation, so the
+            # final window's tail rows clamp onto the slot's OWN last
+            # block — only the live mask keeps them in the trash.
+            ([4] * 24, 8, 0.0, 0)]
+    want = [_generate_stream(params, p, mx, t, s) for p, mx, t, s in reqs]
+    got = _engine_streams(params, reqs, num_slots=3, prefill_chunk=16,
+                          speculate=_spec(params, 3))
+    assert got == want
+
+
+def test_speculative_greedy_neighbors_unperturbed_by_sampling(params):
+    """A greedy stream sharing a speculative batch with sampling
+    neighbors must stay bitwise — rejection sampling consumes the
+    NEIGHBOR's key, never the greedy slot's tokens."""
+    reqs = [([5, 17, 3], 6, 0.8, 13), ([8, 8], 7, 0.0, 0)]
+    got = _engine_streams(params, reqs, num_slots=2, prefill_chunk=4,
+                          speculate=_spec(params, 2))
+    assert got[1] == _generate_stream(params, [8, 8], 7, 0.0, 0)
+    assert len(got[0]) == 6
 
 
 def test_bf16_kv_cache_close_to_fp32(params):

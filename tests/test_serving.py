@@ -63,6 +63,36 @@ def test_allocator_free_validates():
         a.free([got[0]])
 
 
+def test_allocator_share_refcounts_and_physical_free():
+    """CoW sharing semantics (ISSUE 13 satellite): ``share`` adds
+    references without touching the free list or the physical peak;
+    ``free`` returns a block to the pool only when the LAST reference
+    drops, reporting exactly the physically-freed blocks."""
+    a = BlockAllocator(8)
+    got = a.alloc(3)
+    assert a.in_use == 3
+    a.share(got[:2])
+    assert a.in_use == 3 and a.peak_in_use == 3      # refs are not blocks
+    assert a.refcount(got[0]) == 2 and a.refcount(got[2]) == 1
+    freed = a.free(got)                              # drops one ref each
+    assert freed == [got[2]]                         # only the unshared one
+    assert a.in_use == 2
+    freed = a.free(got[:2])                          # last refs
+    assert sorted(freed) == sorted(got[:2]) and a.in_use == 0
+
+
+def test_allocator_share_validates():
+    a = BlockAllocator(6)
+    got = a.alloc(2)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.share([5])                 # never allocated
+    a.free(got)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.share([got[0]])            # already freed
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got[0]])
+
+
 def test_blocks_for_and_sizing_math():
     assert blocks_for(0, 4) == 0
     assert blocks_for(1, 4) == 1
