@@ -180,6 +180,85 @@ def _time_batch_subprocess(overrides: dict, bs: int, timeout: int
     return float(tps), int(n_dev)
 
 
+def _pp_one(spec_json: str) -> None:
+    """--pp-one mode: time a single PP-fusion sweep row and print its
+    total tokens/sec.
+
+    Runs in a child process because the parent bench's backend is already
+    initialized with the host's real device count (1 on the CPU fallback)
+    and a pipeline row needs a multi-device ``(data, stage)`` topology:
+    the child pins 4 virtual CPU devices BEFORE its first device use
+    (experiments/_cpu_pin — also serializes dispatch, the documented
+    virtual-mesh hardening). Reduced model, same shape as
+    ``_reduced_dp_setup``'s CPU branch: the rows measure the dispatch-
+    fusion ratio, not absolute model throughput."""
+    import dataclasses
+    import json as _json
+
+    from experiments._cpu_pin import pin_cpu_virtual
+    pin_cpu_virtual(4)
+    from ddl25spring_tpu.bench_utils import time_pp_train_step
+    spec = _json.loads(spec_json)
+    topo = spec.pop("_mesh")
+    spd = spec.pop("_spd", 1)
+    agg = spec.pop("_agg", "gradient")
+    wire = spec.pop("_wire", None)
+    ovl = spec.pop("_ovl", 0)
+    cfg = dataclasses.replace(
+        LlamaConfig(), vocab_size=2048, dmodel=64, num_heads=2, n_layers=2,
+        ctx_size=64, attention_impl="xla", **spec)
+    mesh = make_mesh(topo)
+    print(time_pp_train_step(mesh, cfg, 4, n_microbatches=2,
+                             schedule="gpipe", steps_per_dispatch=spd,
+                             aggregation=agg, wire=wire,
+                             overlap_microbatches=ovl,
+                             warmup=WARMUP, timed_steps=TIMED_STEPS))
+
+
+def _pp_sidebar() -> None:
+    """PP-fusion sweep rows (CPU fallback only, stderr, never sinks the
+    bench): the PR 14 composition column measured today instead of waiting
+    on a live chip — per-step GPipe vs the fused K=4 scan driver
+    (pp.make_pipeline_multi_step; the per-step dispatch tax is the ~1.6×
+    PR 4 number this row tracks), and the full DP×PP composition
+    (zero1 + int8 ring + scan4 through pp.make_pipeline_overlap_multi_step).
+    Each row is a subprocess on a 4-virtual-device mesh (see _pp_one);
+    QUICK mode shortens the timed window via the inherited env. The
+    data-axis WIRE claim is not timed here — experiments/pp_fusion_smoke.py
+    carries it exactly, trace-time."""
+    import json as _json
+    import subprocess
+    rows = [
+        ("pp-gpipe", {"_mesh": {"data": 1, "stage": 2}}),
+        ("pp-gpipe+scan4", {"_mesh": {"data": 1, "stage": 2}, "_spd": 4}),
+        ("dp2pp2+z1scan4+int8ring",
+         {"_mesh": {"data": 2, "stage": 2}, "_spd": 4, "_agg": "zero1",
+          "_wire": "int8_ef", "_ovl": 1}),
+    ]
+    got = {}
+    for label, spec in rows:
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--pp-one", _json.dumps(spec)],
+                capture_output=True, text=True, timeout=420)
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr.strip().splitlines()[-1]
+                                   if proc.stderr.strip()
+                                   else "child failed")
+            got[label] = float(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # one row must not sink the sidebar
+            print(f"pp row {label}: failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            continue
+        print(f"pp row {label:24s}: {got[label]:10.0f} tok/s total",
+              file=sys.stderr)
+    if "pp-gpipe" in got and "pp-gpipe+scan4" in got:
+        # The acceptance-bar line: fused-dispatch speedup, per train step.
+        print(f"pp fusion speedup (scan4 vs per-step): "
+              f"{got['pp-gpipe+scan4'] / got['pp-gpipe']:.2f}x",
+              file=sys.stderr)
+
+
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                 new_tokens: int = 128, bf16_params: bool = False,
                 kv_dtype=None) -> float:
@@ -646,9 +725,18 @@ def main():
         print(f"fleet bench: failed ({type(e).__name__}: {e})",
               file=sys.stderr)
 
+    # PP-fusion sidebar (ISSUE 14): on the CPU fallback the pipeline
+    # rows need virtual devices, so they run as subprocesses; on a real
+    # chip the PP sweep belongs to experiments/pp_schedules.py where the
+    # topology is sized to the slice.
+    if PLATFORM in (None, "cpu"):
+        _pp_sidebar()
+
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] == "--one":
         _time_batch_one(sys.argv[2], sys.argv[3])
+    elif len(sys.argv) == 3 and sys.argv[1] == "--pp-one":
+        _pp_one(sys.argv[2])
     else:
         main()

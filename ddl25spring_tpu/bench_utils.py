@@ -150,6 +150,88 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
     return n_dev * batch_size * seq * timed_steps / dt
 
 
+def time_pp_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
+                       seq: Optional[int] = None,
+                       n_microbatches: int = 1, schedule: str = "gpipe",
+                       opt_name: str = "fused",
+                       wire: Optional[str] = None,
+                       warmup: int = 3, timed_steps: int = 20,
+                       steps_per_dispatch: int = 1,
+                       aggregation: str = "gradient",
+                       overlap_microbatches: int = 0) -> float:
+    """Total tokens/sec of the PIPELINE train step — ``time_train_step``'s
+    contract on a ``(data, stage)`` mesh (parallel/pp.py).
+
+    ``batch_size`` is per data shard (must divide by ``n_microbatches``);
+    the return is TOTAL tokens/sec — ``n_data · batch_size`` tokens per
+    step, because stage devices share one batch — and the caller divides
+    by its device count for the per-chip figure. The lever spellings match
+    ``time_train_step`` one for one so sweep rows stay comparable:
+    ``steps_per_dispatch`` = K > 1 times the fused K-step scan driver
+    (``pp.make_pipeline_multi_step`` — any schedule, bitwise to K=1);
+    ``overlap_microbatches`` = M >= 1 routes the DP×PP data-axis sync
+    through the compressed/overlapped ring
+    (``pp.make_pipeline_overlap_*``), where ``wire`` and
+    ``aggregation="zero1"`` compose; M = 0 is the plain pmean data sync
+    (``wire``/zero1 then unsupported, matching the trainer's rules)."""
+    from .parallel import pp
+
+    seq = seq or cfg.ctx_size
+    n_data = mesh.shape.get("data", 1)
+    K = max(1, int(steps_per_dispatch))
+    M = int(overlap_microbatches)
+    params = llama.init_llama(jax.random.key(0), cfg)
+    opt = make_optimizer(opt_name)
+
+    if M >= 1:
+        maker = (pp.make_pipeline_overlap_multi_step if K > 1
+                 else pp.make_pipeline_overlap_step)
+        state, step = maker(cfg, opt, mesh, params,
+                            n_microbatches=n_microbatches,
+                            schedule=schedule, aggregation=aggregation,
+                            wire=wire or "fp32", overlap_microbatches=M)
+    else:
+        if wire is not None or aggregation != "gradient":
+            raise ValueError("PP wire compression / zero1 route through "
+                             "the ring driver: pass "
+                             "overlap_microbatches >= 1")
+        state = pp.init_state(mesh, params, opt)
+        maker = (pp.make_pipeline_multi_step if K > 1
+                 else pp.make_pipeline_step)
+        step = maker(cfg, opt, mesh, n_microbatches=n_microbatches,
+                     schedule=schedule)
+    tokens = jax.random.randint(jax.random.key(1),
+                                (n_data * batch_size, seq),
+                                0, cfg.vocab_size)
+    if K > 1:
+        window = pp.shard_batch_window(
+            mesh, jnp.broadcast_to(tokens, (K,) + tokens.shape))
+        warm_chunks = max(1, -(-warmup // K))
+        timed_chunks = max(1, -(-timed_steps // K))
+        for _ in range(warm_chunks):
+            state, losses = step(state, window)
+        float(losses[-1])  # hard sync before the timer
+        t0 = time.perf_counter()
+        for _ in range(timed_chunks):
+            state, losses = step(state, window)
+        float(losses[-1])  # forces the whole timed chain
+        dt = time.perf_counter() - t0
+        del state
+        return n_data * batch_size * seq * timed_chunks * K / dt
+
+    batch = pp.shard_batch(mesh, tokens)
+    for _ in range(warmup):
+        state, loss = step(state, batch)
+    float(loss)  # hard sync before the timer
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, loss = step(state, batch)
+    float(loss)  # forces the whole timed chain
+    dt = time.perf_counter() - t0
+    del state
+    return n_data * batch_size * seq * timed_steps / dt
+
+
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                 new_tokens: int = 128, bf16_params: bool = False,
                 kv_dtype: Optional[str] = None, reps: int = 3) -> float:

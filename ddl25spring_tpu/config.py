@@ -156,10 +156,12 @@ class TrainConfig:
     # single-pass), "pallas" (ops/pallas_adam.py fused apply), "master"
     # (ops/mixed_precision.py — pair with LlamaConfig param_dtype bf16).
     optimizer: str = "adam"
-    # Gradient-allreduce wire format for the DP trainer: "fp32" (plain
-    # pmean), "bf16" or "int8_ef" (parallel/compress.py). On a
-    # hierarchical mesh (dcn > 1) this is the ICI tier's format and
-    # ``wire_dcn`` selects the DCN tier's.
+    # Gradient-allreduce wire format: "fp32" (plain pmean), "bf16" or
+    # "int8_ef" (parallel/compress.py). On a hierarchical mesh (dcn > 1)
+    # this is the ICI tier's format and ``wire_dcn`` selects the DCN
+    # tier's. On the PP trainer a non-fp32 wire requires
+    # overlap_microbatches >= 1 — it rides the DP×PP data-axis ring
+    # (parallel/pp.py make_pipeline_overlap_*).
     wire: str = "fp32"
     # DCN-tier wire format of the two-level hierarchical collectives
     # (requires dcn > 1 and overlap_microbatches >= 1): "" defaults to
@@ -169,17 +171,21 @@ class TrainConfig:
     # shape; parallel/compress.py hier_reduce_scatter).
     wire_dcn: str = ""
     accum_steps: int = 1           # DP gradient accumulation (dp.py)
-    # Fused multi-step dispatch (DP trainer): K > 1 lax.scans K training
-    # steps over a [K, B, T] device-resident batch window in ONE compiled,
-    # donated dispatch (dp.make_multi_step / make_zero1_multi_step) — the
-    # per-step Python dispatch overhead is paid once per window. Loss
-    # trajectory is bit-identical to K=1; host-side work (loss sink,
-    # telemetry step events, checkpoint saves, StepGuard verdicts, preempt
-    # checks) quantizes to chunk edges — see train/llm.py:_run_loop.
+    # Fused multi-step dispatch (DP and PP trainers): K > 1 lax.scans K
+    # training steps over a [K, B, T] device-resident batch window in ONE
+    # compiled, donated dispatch (dp.make_multi_step /
+    # make_zero1_multi_step; pp.make_pipeline_multi_step for any pipeline
+    # schedule) — the per-step Python dispatch overhead is paid once per
+    # window. Loss trajectory is bit-identical to K=1; host-side work
+    # (loss sink, telemetry step events, checkpoint saves, StepGuard
+    # verdicts, preempt checks) quantizes to chunk edges — see
+    # train/llm.py:_run_loop.
     steps_per_dispatch: int = 1
-    # Overlapped+compressed gradient sync (parallel/compress.py, DP
-    # trainer): M >= 1 routes gradient sync through the ACCO-style
-    # microbatch ring driver — each step's local batch splits into M
+    # Overlapped+compressed gradient sync (parallel/compress.py; on the
+    # PP trainer the DP×PP data-axis version, parallel/pp.py
+    # make_pipeline_overlap_*): M >= 1 routes gradient sync through the
+    # ACCO-style microbatch ring driver — each step's local batch splits
+    # into M
     # microbatches and microbatch k+1's grad compute overlaps microbatch
     # k's ppermute-pipelined ring reduce-scatter, with the in-flight
     # chunks in the ``wire`` format (fp32 / bf16 / int8+error-feedback,
@@ -192,13 +198,15 @@ class TrainConfig:
     # M > 1 trades wire for overlap — see docs/COMPONENTS.md's
     # composition matrix.
     overlap_microbatches: int = 0
-    # In-jit numerics summaries (telemetry/introspect.py; DP trainer,
-    # gradient/zero1): N > 0 instruments the compiled step with
+    # In-jit numerics summaries (telemetry/introspect.py; DP trainer
+    # gradient/zero1, PP trainer via pp.make_pp_numerics with block
+    # groups stage-qualified): N > 0 instruments the compiled step with
     # per-layer-group grad/param/update norms + per-leaf NaN attribution
     # and emits a ``numerics`` event every N steps (the emission syncs the
     # tiny summary arrays; the in-jit compute itself is free and
     # bitwise-invisible — losses/params identical on vs off, pinned in
-    # tests/test_introspect.py). 0 disables instrumentation entirely.
+    # tests/test_introspect.py and tests/test_pp.py). 0 disables
+    # instrumentation entirely.
     numerics_every: int = 0
 
 

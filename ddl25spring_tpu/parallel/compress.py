@@ -385,7 +385,13 @@ class OverlapEFState(NamedTuple):
     state tree, so the accumulated quantization error survives
     ``make_overlap_multi_step`` composition, chunk-edge checkpoints and a
     preempt/resume cycle exactly (pinned in tests/test_compress.py and
-    tests/test_hier_collectives.py)."""
+    tests/test_hier_collectives.py).
+
+    The DP×PP drivers (parallel/pp.py ``_pp_overlap_setup``) reuse this
+    tuple with a ``stage`` axis spliced in — ring ``[n, S, n·local]``,
+    gather ``[n, S, local]``, sharded ``P("data", "stage")`` — because
+    each (data, stage) shard compensates its OWN stage slice's
+    quantization error (same bars, pinned in tests/test_pp.py)."""
     params: Any
     opt_state: Any
     step: jnp.ndarray

@@ -354,7 +354,12 @@ def hier_slice_index(n_dcn: int):
 def slice_index(mesh: Mesh):
     """This shard's slice of the padded flat param vector (trace-time,
     must run inside ``shard_map``): the ``data`` rank on a flat mesh,
-    ``hier_slice_index`` on a hierarchical one."""
+    ``hier_slice_index`` on a hierarchical one. On a mesh that also
+    carries a ``stage`` axis the same data-rank ownership map applies
+    PER STAGE GROUP — the DP×PP drivers (parallel/pp.py
+    ``_pp_overlap_setup``) read ``lax.axis_index("data")`` directly and
+    shard their moments/residuals ``(data, stage)``, each stage's shard
+    group owning its own stage slice's 1/n."""
     axes = data_axes(mesh)
     if len(axes) == 1:
         return lax.axis_index(axes[0])
@@ -369,7 +374,9 @@ def _zero1_setup(optimizer, mesh: Mesh, params):
     taken one step further, from "moments on the right devices" to "each
     device holds only its slice"; on a hierarchical mesh the slice is the
     one ``slice_index`` assigns). Returns ``(state, opt_specs, n, pad,
-    local, total)``."""
+    local, total)``. The DP×PP generalization — the same geometry per
+    STAGE slice, moments ``[n, S, local]`` sharded ``(data, stage)`` —
+    lives in parallel/pp.py ``_pp_overlap_setup``."""
     from ..utils import pytree as pt
 
     dpart = data_partition(mesh)

@@ -111,7 +111,8 @@ def init_state(mesh: Mesh, params: dict, optimizer: optax.GradientTransformation
 def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                             n_stages: int, n_microbatches: int,
                             has_data_axis: bool,
-                            tp: int = 1) -> Tuple[jnp.ndarray, dict]:
+                            tp: int = 1,
+                            comm_scale: int = 1) -> Tuple[jnp.ndarray, dict]:
     """Per-device body (runs under shard_map): GPipe forward over ticks,
     grads via autodiff, cross-stage/data reductions.
 
@@ -122,6 +123,12 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     the loss is scaled by 1/tp under differentiation — every model shard
     seeds an identical loss replica, and the in-forward psums (transpose:
     psum) would otherwise count each weight path tp times.
+
+    ``comm_scale`` is the telemetry execution multiplier for the fused
+    K-step scan driver (``make_pipeline_multi_step``): the body traces
+    once per compilation but runs K times per dispatch, and the comm
+    wrappers record that trip count so the static wire profile stays
+    exact (the ``_make_local_grad_step`` convention, parallel/dp.py).
     """
     stage = lax.axis_index("stage")
     is_first = stage == 0
@@ -157,7 +164,7 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             # the backward hops autodiff adds are telemetry/comm.py's
             # documented under-count.)
             x_next = comm.ppermute(h, "stage", fwd, label="pp_activation_hop",
-                                   scale=n_ticks)
+                                   scale=n_ticks * comm_scale)
             return (x_next, loss_sum + mb_loss), None
 
         x0 = jnp.zeros((mb, t, cfg.dmodel), jnp.dtype(cfg.dtype))
@@ -172,13 +179,20 @@ def _pipeline_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         return loss_sum / n_microbatches / tp
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
-    return _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp)
+    return _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp,
+                                  comm_scale)
 
 
-def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
-    """Cross-stage/model/data reductions shared by both schedules."""
+def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp,
+                           comm_scale: int = 1):
+    """Cross-stage/model/data reductions shared by all three schedules.
+
+    ``has_data_axis=False`` with a real ``data`` axis present is the
+    composed DP×PP path (``make_pipeline_overlap_*``): the cross-STAGE
+    reductions still run, but the data-axis sync is left to the caller's
+    ring driver — the seam where zero1/wire-compression attach."""
     loss = comm.psum(loss, "stage",  # broadcast + undo 1/tp for reporting
-                     label="pp_loss_allreduce") * tp
+                     label="pp_loss_allreduce", scale=comm_scale) * tp
 
     def reduce_grad(name, g):
         # Block weight matrices under TP are sharded over ``model`` — their
@@ -189,7 +203,8 @@ def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
         if tp_axis is not None and name not in _TP_COL | _TP_ROW:
             g = jax.tree.map(
                 lambda x: comm.psum(x, tp_axis,
-                                    label="tp_replicated_grads"), g)
+                                    label="tp_replicated_grads",
+                                    scale=comm_scale), g)
         return g
 
     grads = {
@@ -197,15 +212,18 @@ def _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp):
             if k == "blocks"
             else jax.tree.map(
                 lambda g: comm.psum(g, "stage",
-                                    label="pp_replicated_grads"),
+                                    label="pp_replicated_grads",
+                                    scale=comm_scale),
                 reduce_grad(k, v)))
         for k, v in grads.items()
     }
     if has_data_axis:
         # The DP×PP cross-pipeline sync — for ALL stages, not just stage 0
         # (the reference's [0,3]-only allreduce is a recorded bug).
-        grads = comm.pmean(grads, "data", label="grad_allreduce")
-        loss = comm.pmean(loss, "data", label="loss_allreduce")
+        grads = comm.pmean(grads, "data", label="grad_allreduce",
+                           scale=comm_scale)
+        loss = comm.pmean(loss, "data", label="loss_allreduce",
+                          scale=comm_scale)
     return loss, grads
 
 
@@ -284,7 +302,8 @@ def _interleave_order(n_layers: int, n_stages: int, n_chunks: int) -> jnp.ndarra
 def _pipeline_interleaved_loss_and_grad(params: dict, tokens: jnp.ndarray,
                                         cfg: LlamaConfig, n_stages: int,
                                         n_microbatches: int, has_data_axis: bool,
-                                        tp: int = 1, n_chunks: int = 2
+                                        tp: int = 1, comm_scale: int = 1,
+                                        n_chunks: int = 2
                                         ) -> Tuple[jnp.ndarray, dict]:
     """Interleaved virtual-stage schedule (Megatron-LM's "virtual pipeline"):
     each stage holds ``v = n_chunks`` non-contiguous layer chunks and every
@@ -355,7 +374,7 @@ def _pipeline_interleaved_loss_and_grad(params: dict, tokens: jnp.ndarray,
                 lambda: llama.head_loss(p, h, tok, cfg),
                 lambda: jnp.zeros((), jnp.float32))
             x_next = comm.ppermute(h, "stage", fwd, label="pp_activation_hop",
-                                   scale=n_ticks)
+                                   scale=n_ticks * comm_scale)
             return (x_next, loss_sum + mb_loss), None
 
         x0 = jnp.zeros((mb, t, cfg.dmodel), jnp.dtype(cfg.dtype))
@@ -364,13 +383,15 @@ def _pipeline_interleaved_loss_and_grad(params: dict, tokens: jnp.ndarray,
         return loss_sum / n_microbatches / tp   # same seeding rule as GPipe
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
-    return _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp)
+    return _reduce_loss_and_grads(loss, grads, tp_axis, has_data_axis, tp,
+                                  comm_scale)
 
 
 def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                                  n_stages: int, n_microbatches: int,
                                  has_data_axis: bool,
-                                 tp: int = 1) -> Tuple[jnp.ndarray, dict]:
+                                 tp: int = 1,
+                                 comm_scale: int = 1) -> Tuple[jnp.ndarray, dict]:
     """1F1B (one-forward-one-backward) schedule, hand-written backward.
 
     GPipe (above) lets autodiff transpose the whole forward scan, which means
@@ -433,7 +454,8 @@ def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaCo
         stash = lax.dynamic_update_index_in_dim(
             stash, jnp.where(valid_f, act_in, old), slot_f, axis=0)
         x_fwd = comm.ppermute(h, "stage", fwd_perm,
-                              label="pp_activation_hop", scale=n_iters)
+                              label="pp_activation_hop",
+                              scale=n_iters * comm_scale)
 
         # --- B sub-tick: vjp-recompute microbatch i_b from its stash ------
         i_b = j - 2 * (n_stages - 1) + stage
@@ -454,7 +476,8 @@ def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaCo
         grads = jax.tree.map(jnp.add, grads, dp)
         loss_sum = loss_sum + jnp.where(is_last & valid_b, mb_loss, 0.0)
         g_bwd = comm.ppermute(da.astype(dt), "stage", bwd_perm,
-                              label="pp_cotangent_hop", scale=n_iters)
+                              label="pp_cotangent_hop",
+                              scale=n_iters * comm_scale)
 
         return (stash, grads, loss_sum, x_fwd, g_bwd), None
 
@@ -466,12 +489,141 @@ def _pipeline_1f1b_loss_and_grad(params: dict, tokens: jnp.ndarray, cfg: LlamaCo
         (stash0, grads0, jnp.zeros((), jnp.float32), act0, act0),
         jnp.arange(n_iters))
     return _reduce_loss_and_grads(loss_sum / n_microbatches / tp, grads,
-                                  tp_axis, has_data_axis, tp)
+                                  tp_axis, has_data_axis, tp, comm_scale)
+
+
+def _schedule_body(schedule: str, n_chunks: int) -> Callable:
+    """The per-shard loss+grad body for a schedule name — the ONE lookup
+    every pipeline step factory routes through, so a new factory cannot
+    support a different schedule set by accident."""
+    if schedule == "interleaved":
+        return functools.partial(_pipeline_interleaved_loss_and_grad,
+                                 n_chunks=n_chunks)
+    try:
+        return {"gpipe": _pipeline_loss_and_grad,
+                "1f1b": _pipeline_1f1b_loss_and_grad}[schedule]
+    except KeyError:
+        raise ValueError(f"unknown schedule {schedule!r}: expected 'gpipe', "
+                         "'1f1b' or 'interleaved'") from None
+
+
+def _opt_specs(opt_state, params, specs):
+    """PartitionSpecs for a pipeline optimizer state: moment subtrees
+    (anything tree-isomorphic to params — adam's mu/nu) inherit the param
+    specs, scalars (count) replicate — ``sharded_opt_init``'s placement
+    rule as SPECS, computable from a traced state inside a jitted step
+    (only tree structure is read, never values)."""
+    pstruct = jax.tree.structure(params)
+
+    def is_params_like(node):
+        try:
+            return jax.tree.structure(node) == pstruct
+        except Exception:
+            return False
+
+    return jax.tree.map(
+        lambda node: specs if is_params_like(node)
+        else jax.tree.map(lambda _: P(), node),
+        opt_state, is_leaf=is_params_like)
+
+
+def _check_layout(params_tag, schedule: str, n_stages: int,
+                  n_chunks: int) -> None:
+    """The interleaved-layout sanity check shared by every factory:
+    schedule="interleaved" demands the interleave_params tag for exactly
+    this (S, v); any other schedule demands its absence."""
+    if schedule == "interleaved":
+        want = _layout_tag(n_stages, n_chunks)
+        if params_tag is None:
+            raise ValueError(
+                "schedule='interleaved' requires params permuted with "
+                "interleave_params(params, n_stages, n_chunks) before "
+                "init_state — natural-layout blocks would run layers "
+                "in the wrong order")
+        if float(params_tag) != want:
+            raise ValueError(
+                f"params were interleaved for a different topology "
+                f"(tag {float(params_tag):.0f}, expected {want:.0f} = "
+                f"stages*1000+chunks)")
+    elif params_tag is not None:
+        raise ValueError(
+            f"params carry the interleaved layout tag but "
+            f"schedule={schedule!r} expects natural layer order — "
+            f"undo with deinterleave_params first")
+
+
+def _layout_guarded(jitted: Callable, schedule: str, n_stages: int,
+                    n_chunks: int) -> Callable:
+    """First-call layout guard around a jitted pipeline step (params are
+    concrete at the Python call boundary, and reading the scalar here
+    avoids a per-step host sync)."""
+    checked = []
+
+    def guarded(state: TrainState, tokens):
+        if not checked:
+            _check_layout(state.params.get(_LAYOUT_KEY), schedule,
+                          n_stages, n_chunks)
+            checked.append(True)
+        return jitted(state, tokens)
+
+    guarded.lower = jitted.lower   # AOT inspection (experiments/pp_schedules)
+    if hasattr(jitted, "_cache_size"):
+        # CompileWatch's compile/retrace detection reads the jit cache
+        # size through whatever it wraps (introspect.CompileWatch._size);
+        # without this passthrough the guard wrapper silently disables
+        # compile accounting for every pipeline step factory (pinned by
+        # experiments/pp_fusion_smoke.py's retrace + compile-meta gates).
+        guarded._cache_size = jitted._cache_size
+    return guarded
+
+
+def _make_pp_local_step(cfg: LlamaConfig, optimizer, body: Callable, *,
+                        n_stages: int, n_microbatches: int, has_data: bool,
+                        tp: int, comm_scale: int = 1,
+                        numerics=None) -> Callable:
+    """The per-shard pipeline train-step body shared by the per-step
+    factory (``make_pipeline_step``) and the K-step scan driver
+    (``make_pipeline_multi_step``) — the ``_make_local_grad_step`` pattern
+    (parallel/dp.py): one implementation, so per-step and fused dispatch
+    cannot drift, and their bitwise equality at any K is a structural
+    property, not a numerical accident (pinned in tests/test_pp.py for
+    all three schedules).
+
+    Runs under shard_map over (data, stage[, model]). The optimizer is
+    applied to each shard's LOCAL param slice — valid for elementwise
+    optimizers (sgd/adam/adamw/..., the same slice-commuting argument as
+    ZeRO-1, ops/adam.py), which is every optimizer this repo ships. The
+    interleaved layout tag is re-pinned exactly after the update.
+
+    ``numerics`` (a ``make_pp_numerics`` handle): the second output
+    becomes ``(loss, NumericsSummary)`` with stage-stacked group stats —
+    extra OUTPUTS only, so losses/params are bitwise identical on vs off.
+    """
+
+    def local_step(state: TrainState, tokens):
+        loss, grads = body(state.params, tokens, cfg, n_stages,
+                           n_microbatches, has_data, tp,
+                           comm_scale=comm_scale)
+        params, opt_state = apply_optimizer(optimizer, grads,
+                                            state.opt_state, state.params)
+        if _LAYOUT_KEY in params:
+            # Keep the layout tag exactly invariant under ANY optimizer —
+            # zero grad does not protect it from params-coupled transforms
+            # like decoupled weight decay.
+            params = dict(params, **{_LAYOUT_KEY: state.params[_LAYOUT_KEY]})
+        new_state = TrainState(params, opt_state, state.step + 1)
+        if numerics is not None:
+            summary = numerics.summarize(state.params, grads, params)
+            return new_state, (loss, summary)
+        return new_state, loss
+
+    return local_step
 
 
 def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
                        mesh: Mesh, n_microbatches: int = 1,
-                       schedule: str = "gpipe", n_chunks: int = 2) -> Callable:
+                       schedule: str = "gpipe", n_chunks: int = 2,
+                       numerics=None) -> Callable:
     """jit-compiled pipeline train step over mesh axes (data, stage).
 
     ``n_microbatches=1`` degenerates to the reference's naive staged pipeline
@@ -487,73 +639,560 @@ def make_pipeline_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation
     the first step — and n_microbatches divisible by n_stages) — all
     compute the identical gradient.
 
+    ``numerics`` (``make_pp_numerics``) arms the in-jit run-health summary;
+    the step then returns ``(state, (loss, NumericsSummary))``.
+
+    ``optimizer`` must be ELEMENTWISE (sgd / adam / adamw / the ops/
+    fused variants — everything this repo ships): the update runs inside
+    shard_map on each shard's local stage slice (so the per-step and
+    fused K-step drivers share one body bitwise), which is only
+    equivalent to a full-tree update for transforms that commute with
+    slicing. A globally-coupled transform (e.g.
+    ``optax.clip_by_global_norm``) would clip per stage slice — wrong
+    silently; keep such chains on the DP trainer.
+
     Returns ``step(state, tokens) -> (state, loss)`` where tokens is the
     global [B, T] batch, B divisible by data_size · n_microbatches.
     """
     n_stages = mesh.shape["stage"]
     has_data = mesh.shape.get("data", 1) > 1
     tp = mesh.shape.get("model", 1)
-    body = {"gpipe": _pipeline_loss_and_grad,
-            "1f1b": _pipeline_1f1b_loss_and_grad,
-            "interleaved": functools.partial(
-                _pipeline_interleaved_loss_and_grad, n_chunks=n_chunks),
-            }[schedule]
+    body = _schedule_body(schedule, n_chunks)
+    local_step = _make_pp_local_step(cfg, optimizer, body, n_stages=n_stages,
+                                     n_microbatches=n_microbatches,
+                                     has_data=has_data, tp=tp,
+                                     numerics=numerics)
 
-    def sharded_grads(params, tokens):
-        return body(params, tokens, cfg, n_stages,
-                    n_microbatches, has_data, tp)
-
-    def step(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
+    def step(state: TrainState, tokens):
         specs = param_specs(state.params, tp=tp > 1)
-        loss, grads = shard_map(
-            sharded_grads, mesh=mesh,
-            in_specs=(specs, P("data") if has_data else P()),
-            out_specs=(P(), specs),
+        state_specs = TrainState(specs,
+                                 _opt_specs(state.opt_state, state.params,
+                                            specs), P())
+        out_specs = (state_specs,
+                     ((P(), numerics.summary_specs()) if numerics is not None
+                      else P()))
+        return shard_map(
+            local_step, mesh=mesh,
+            in_specs=(state_specs, P("data") if has_data else P()),
+            out_specs=out_specs,
             check_vma=False,
-        )(state.params, tokens)
-        params, opt_state = apply_optimizer(optimizer, grads,
-                                            state.opt_state, state.params)
-        if _LAYOUT_KEY in params:
-            # Keep the layout tag exactly invariant under ANY optimizer —
-            # zero grad does not protect it from params-coupled transforms
-            # like decoupled weight decay.
-            params = dict(params, **{_LAYOUT_KEY: state.params[_LAYOUT_KEY]})
-        return TrainState(params, opt_state, state.step + 1), loss
+        )(state, tokens)
 
     jitted = jax.jit(step, donate_argnums=(0,))
+    return _layout_guarded(jitted, schedule, n_stages, n_chunks)
 
-    # Layout guard (first call only — params are concrete at the Python call
-    # boundary, and reading the scalar here avoids a per-step host sync):
-    # schedule="interleaved" demands the interleave_params tag for exactly
-    # this (S, v); any other schedule demands its absence.
-    checked = []
 
-    def guarded(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
-        if not checked:
-            tag = state.params.get(_LAYOUT_KEY)
-            if schedule == "interleaved":
-                want = _layout_tag(n_stages, n_chunks)
-                if tag is None:
-                    raise ValueError(
-                        "schedule='interleaved' requires params permuted with "
-                        "interleave_params(params, n_stages, n_chunks) before "
-                        "init_state — natural-layout blocks would run layers "
-                        "in the wrong order")
-                if float(tag) != want:
-                    raise ValueError(
-                        f"params were interleaved for a different topology "
-                        f"(tag {float(tag):.0f}, expected {want:.0f} = "
-                        f"stages*1000+chunks)")
-            elif tag is not None:
-                raise ValueError(
-                    f"params carry the interleaved layout tag but "
-                    f"schedule={schedule!r} expects natural layer order — "
-                    f"undo with deinterleave_params first")
-            checked.append(True)
-        return jitted(state, tokens)
+def make_pipeline_multi_step(cfg: LlamaConfig,
+                             optimizer: optax.GradientTransformation,
+                             mesh: Mesh, n_microbatches: int = 1,
+                             schedule: str = "gpipe", n_chunks: int = 2,
+                             numerics=None) -> Callable:
+    """Fused K-step pipeline driver: ``step(state, window) -> (state,
+    losses)`` where ``window`` is a device-resident ``[K, B, T]`` token
+    window (leading axis = K consecutive training steps, second axis
+    sharded over ``data`` on a DP×PP mesh — ``shard_batch_window``) and
+    ``losses`` is the ``[K]`` per-step loss sequence from the scan's
+    stacked outputs.
 
-    guarded.lower = jitted.lower   # AOT inspection (experiments/pp_schedules)
-    return guarded
+    One compiled, donated dispatch runs all K steps of ANY schedule
+    (gpipe / 1f1b / interleaved): the per-step Python dispatch, donation
+    bookkeeping and host round trip — the ~1.6× per-step tax on
+    dispatch-bound hosts (PR 4 bench) that the PP schedules kept paying
+    after DP stopped — are paid once per window. The scanned body IS
+    ``make_pipeline_step``'s body (shared ``_make_pp_local_step``), so the
+    loss sequence and final params are BITWISE identical to K per-step
+    calls at K∈{1,4} for every schedule (tests/test_pp.py), and per-step
+    wire bytes are unchanged — the comm profile records the same
+    collectives at ``scale=K`` per dispatch
+    (``CommProfile.as_dict(steps_per_dispatch=K)`` normalizes).
+
+    K is read from the window's static leading dim at trace time, so ONE
+    returned callable serves every chunk size (a tail chunk of k < K
+    steps just triggers one more compile for that shape — the trainer's
+    CompileWatch stamps each compile event with its actual window size).
+
+    ``optimizer`` must be elementwise — same rule and reason as
+    ``make_pipeline_step`` (the shared per-shard body applies it to the
+    local stage slice).
+    """
+    n_stages = mesh.shape["stage"]
+    has_data = mesh.shape.get("data", 1) > 1
+    tp = mesh.shape.get("model", 1)
+    body = _schedule_body(schedule, n_chunks)
+
+    def step(state: TrainState, window):
+        specs = param_specs(state.params, tp=tp > 1)
+        state_specs = TrainState(specs,
+                                 _opt_specs(state.opt_state, state.params,
+                                            specs), P())
+
+        def multi(st, win):
+            local_step = _make_pp_local_step(
+                cfg, optimizer, body, n_stages=n_stages,
+                n_microbatches=n_microbatches, has_data=has_data, tp=tp,
+                comm_scale=win.shape[0], numerics=numerics)
+            return lax.scan(local_step, st, win)
+
+        out_specs = (state_specs,
+                     ((P(), numerics.summary_specs(stacked=True))
+                      if numerics is not None else P()))
+        return shard_map(
+            multi, mesh=mesh,
+            in_specs=(state_specs, P(None, "data") if has_data else P()),
+            out_specs=out_specs,
+            check_vma=False,
+        )(state, window)
+
+    jitted = jax.jit(step, donate_argnums=(0,))
+    return _layout_guarded(jitted, schedule, n_stages, n_chunks)
+
+
+# ------------------------------------------- DP×PP data-axis ring drivers
+#
+# The fused hot path built for DP (PRs 3/10/12) stops at the data mesh:
+# ZeRO-1 sliced updates, wire-compressed ring reduce-scatter and ACCO-style
+# microbatch overlap all assume the step sees the FULL params tree. Under
+# DP×PP each (data, stage) shard holds one stage's slice, but the data-axis
+# sync of the CROSS-STAGE-REDUCED gradient has exactly the same shape as
+# flat DP's: flatten the LOCAL stage tree, ring it over ``data``, update
+# the owned 1/n slice, gather the fresh slices back. The drivers below
+# compose the existing machinery (compress.ring_reduce_scatter, the int8
+# encode + EF-residual discipline, dp.slice_index's data-rank ownership)
+# with the pipeline schedule bodies — the one new piece is the residual /
+# moment layout, which gains a ``stage`` axis ([n_data, n_stages, ...],
+# sharded P("data", "stage")) because each stage's shard group compensates
+# its own stage's quantization error.
+
+
+def _pp_flat_geometry(mesh: Mesh, params):
+    """Padded flat-vector geometry of the LOCAL per-stage param tree — the
+    unit the DP×PP data-axis zero1/ring sync operates on. Every stage's
+    local tree has the same flat length (equal [L/S] block slices + the
+    stage-replicated embed/head/final_norm), so the geometry is
+    SPMD-consistent across stages. Returns ``(n, pad, local, total)`` with
+    n = the ``data`` axis size and total = the per-stage param count."""
+    n = mesh.shape.get("data", 1)
+    n_stages = mesh.shape["stage"]
+    total = 0
+    for k, v in params.items():
+        size = sum(int(leaf.size) for leaf in jax.tree.leaves(v))
+        total += size // n_stages if k == "blocks" else size
+    pad = (-total) % n
+    local = (total + pad) // n
+    return n, pad, local, total
+
+
+def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
+                      aggregation: str, schedule: str, n_chunks: int):
+    """State + shard specs + flat geometry for the DP×PP overlap drivers.
+
+    ZeRO-1 moments live as ``[n_data, n_stages, local]`` global arrays
+    sharded ``P("data", "stage")`` — each (d, s) shard owns the moments of
+    stage s's d-th flat slice (the ``dp.slice_index`` data-rank ownership
+    map applied per stage group); int8 EF residuals get the same layout
+    (ring: ``[n, S, n·local]``; gather: ``[n, S, local]``), because each
+    (data, stage) shard compensates its OWN quantization error."""
+    if aggregation not in ("gradient", "zero1"):
+        raise ValueError("the DP×PP overlap driver supports gradient/zero1 "
+                         f"aggregation only (got {aggregation!r})")
+    if wire not in ("fp32", "bf16", "int8_ef"):
+        raise ValueError(f"unknown wire format {wire!r}")
+    if "data" not in mesh.axis_names:
+        raise ValueError("the DP×PP overlap driver needs a mesh with a "
+                         "'data' axis (size 1 is fine) — build it with "
+                         'make_mesh({"data": d, "stage": s})')
+    if mesh.shape.get("dcn", 1) > 1:
+        raise ValueError("the DP×PP overlap driver runs the flat data ring "
+                         "only; the hierarchical (dcn x data) tier is the "
+                         "DP trainer's (parallel/compress.py)")
+    if mesh.shape.get("model", 1) > 1:
+        raise ValueError("the DP×PP overlap driver supports model=1 meshes "
+                         "(TP's partially-synchronized activations are "
+                         "ROADMAP item 7's next lever)")
+    n_stages = mesh.shape["stage"]
+    _check_layout(params.get(_LAYOUT_KEY), schedule, n_stages, n_chunks)
+    n, pad, local, total = _pp_flat_geometry(mesh, params)
+    specs = param_specs(params, tp=False)
+    sharded = shard_params(mesh, params)
+    step0 = jax.device_put(jnp.zeros((), jnp.int32),
+                           NamedSharding(mesh, P()))
+    if aggregation == "zero1":
+        abstract_opt = jax.eval_shape(
+            optimizer.init, jax.ShapeDtypeStruct((local,), jnp.float32))
+        opt_specs = jax.tree.map(
+            lambda x: (P("data", "stage") if getattr(x, "ndim", 0) >= 1
+                       else P()),
+            abstract_opt)
+
+        def local_init(p):
+            from ..utils import pytree as pt
+            flat = jnp.pad(pt.flatten(p)[0].astype(jnp.float32), (0, pad))
+            mine = lax.dynamic_slice_in_dim(
+                flat, lax.axis_index("data") * local, local)
+            opt = optimizer.init(mine)
+            # Vector leaves gain the (data, stage) shard axes; scalars
+            # (count) replicate — every shard steps them identically.
+            return jax.tree.map(
+                lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
+                           else x), opt)
+
+        opt_state = jax.jit(shard_map(
+            local_init, mesh=mesh, in_specs=(specs,),
+            out_specs=opt_specs, check_vma=False))(sharded)
+        state = TrainState(sharded, opt_state, step0)
+    else:
+        opt_state = sharded_opt_init(mesh, sharded, optimizer, specs)
+        opt_specs = _opt_specs(opt_state, sharded, specs)
+        state = TrainState(sharded, opt_state, step0)
+    if wire == "int8_ef":
+        from .compress import OverlapEFState
+        dshard = P("data", "stage")
+        ring_res = jax.device_put(
+            jnp.zeros((n, n_stages, n * local), jnp.float32),
+            NamedSharding(mesh, dshard))
+        gather_res = jax.device_put(
+            jnp.zeros((n, n_stages, local), jnp.float32),
+            NamedSharding(mesh, dshard))
+        state = OverlapEFState(state.params, state.opt_state, state.step,
+                               ring_res, gather_res)
+        state_specs = OverlapEFState(specs, opt_specs, P(), dshard, dshard)
+    else:
+        state_specs = TrainState(specs, opt_specs, P())
+    return state, state_specs, n, pad, local, total
+
+
+def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
+                                *, n_stages: int, n_microbatches: int,
+                                tp: int, n: int, pad: int, local: int,
+                                total: int, microbatches: int, wire: str,
+                                aggregation: str, comm_scale: int = 1,
+                                numerics=None) -> Callable:
+    """The per-shard DP×PP overlapped step body shared by
+    ``make_pipeline_overlap_step`` and ``make_pipeline_overlap_multi_step``.
+
+    Structure per step (under shard_map over (data, stage)): the local
+    batch splits into M sync-microbatches; each runs the FULL pipeline
+    schedule (with its own n_microbatches pipeline microbatches) via the
+    shared schedule body called with ``has_data_axis=False`` — the
+    cross-STAGE reductions still run, but the data-axis pmean is replaced
+    by the ring: microbatch m−1's flat cross-stage-reduced gradient rides
+    the ppermute ring (``compress.ring_reduce_scatter`` over ``data``, in
+    the ``wire`` format with per-(shard, chunk) error feedback) in the same
+    trace positions as microbatch m's schedule — the ACCO overlap, now
+    under the pipeline. Reduced chunks accumulate in fp32 on the owner;
+    zero1 updates the owned slice and gathers fresh params (int8 delta
+    gather under ``wire="int8_ef"`` — everyone applies the same quantized
+    deltas, so replicas stay bitwise in sync), gradient aggregation
+    gathers the reduced gradient (in the wire format) and applies the
+    replicated update.
+
+    Numerics contract mirrors the flat driver's
+    (``compress._make_overlap_local_step``): M>1 re-associates (reduce-
+    then-accumulate vs the pmean path's accumulate-then-reduce), so
+    equivalence vs ``make_pipeline_step`` is fp32-tolerance; M=1 fp32
+    differs only by ring-vs-XLA reduction order. The interleaved layout
+    tag re-pins exactly after the flat update round-trip."""
+    from ..utils import pytree as pt
+    from .compress import _int8_encode, ring_reduce_scatter
+
+    M = microbatches
+    ef = wire == "int8_ef"
+
+    def local_step(state, tokens):
+        params = state.params
+        if tokens.shape[0] % M:
+            raise ValueError(f"local batch {tokens.shape[0]} not divisible "
+                             f"by overlap_microbatches={M}")
+        micro = tokens.reshape((M, -1) + tokens.shape[1:])
+        ring_res = state.ring_residual[0, 0] if ef else None
+        acc = jnp.zeros((local,), jnp.float32)
+        loss_sum = jnp.zeros((), jnp.float32)
+        gacc = None
+        pending = None
+        for m in range(M):
+            l, g = body(params, micro[m], cfg, n_stages, n_microbatches,
+                        False, tp, comm_scale=comm_scale)
+            loss_sum = loss_sum + l.astype(jnp.float32)
+            if numerics is not None:
+                # Extra OUTPUT only: the fp32 grad accumulator feeds the
+                # summary, never the ring — losses/params bitwise on/off.
+                gacc = (jax.tree.map(lambda x: x.astype(jnp.float32), g)
+                        if gacc is None else
+                        jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
+                                     gacc, g))
+            if pending is not None:
+                # Microbatch m−1's ring rides alongside microbatch m's
+                # schedule (the body call above): independent dataflow.
+                red, ring_res = ring_reduce_scatter(
+                    pending, "data", wire=wire, residual=ring_res,
+                    label="pp_ring_grad", comm_scale=comm_scale)
+                acc = acc + red
+            pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
+                              (0, pad))
+        red, ring_res = ring_reduce_scatter(
+            pending, "data", wire=wire, residual=ring_res,
+            label="pp_ring_grad", comm_scale=comm_scale)
+        acc = acc + red
+        g_mine = acc / (n * M)      # mean over data shards and microbatches
+        loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
+                          scale=comm_scale)
+
+        raw_flat, unravel = pt.flatten(params)
+        flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+        gather_res = None
+        shard = lax.axis_index("data")
+        if aggregation == "zero1":
+            p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
+            # Local moment view: [1, 1, local] (data, stage)-sharded
+            # vector leaves squeeze to the flat slice; scalars pass.
+            opt_local = jax.tree.map(
+                lambda x: x[0, 0] if getattr(x, "ndim", 0) >= 3 else x,
+                state.opt_state)
+            new_p_mine, opt_local = apply_optimizer(optimizer, g_mine,
+                                                    opt_local, p_mine)
+            opt_state = jax.tree.map(
+                lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
+                           else x), opt_local)
+            if wire == "int8_ef":
+                # Compressed second leg: broadcast the param DELTA int8
+                # with its own EF residual (the compress.py zero1 rule —
+                # fp32 moments stay exact, replicas stay bitwise in sync).
+                q, s, gather_res = _int8_encode(
+                    (new_p_mine - p_mine) + state.gather_residual[0, 0])
+                q_all = comm.all_gather(q, "data", tiled=True,
+                                        label="pp_delta_gather_int8",
+                                        scale=comm_scale)
+                s_all = comm.all_gather(s[None], "data", tiled=True,
+                                        label="pp_delta_scale_gather",
+                                        scale=comm_scale)
+                flat_new = flat_p + (jnp.repeat(s_all, local)
+                                     * q_all.astype(jnp.float32))
+            else:
+                # bf16 wire compresses the RING leg only — the param
+                # gather stays fp32 (params stay exact, compress.py rule).
+                flat_new = comm.all_gather(new_p_mine, "data", tiled=True,
+                                           label="pp_param_gather",
+                                           scale=comm_scale)
+            new_params = unravel(flat_new[:total].astype(raw_flat.dtype))
+        else:                       # replicated gradient update
+            if wire == "int8_ef":
+                q, s, gather_res = _int8_encode(
+                    g_mine + state.gather_residual[0, 0])
+                q_all = comm.all_gather(q, "data", tiled=True,
+                                        label="pp_grad_gather_int8",
+                                        scale=comm_scale)
+                s_all = comm.all_gather(s[None], "data", tiled=True,
+                                        label="pp_grad_scale_gather",
+                                        scale=comm_scale)
+                flat_g = (jnp.repeat(s_all, local)
+                          * q_all.astype(jnp.float32))
+            elif wire == "bf16":
+                flat_g = comm.all_gather(
+                    g_mine.astype(jnp.bfloat16), "data", tiled=True,
+                    label="pp_grad_gather_bf16",
+                    scale=comm_scale).astype(jnp.float32)
+            else:
+                flat_g = comm.all_gather(g_mine, "data", tiled=True,
+                                         label="pp_grad_gather",
+                                         scale=comm_scale)
+            grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            new_params, opt_state = apply_optimizer(optimizer, grads,
+                                                    state.opt_state, params)
+        if _LAYOUT_KEY in new_params:
+            new_params = dict(new_params,
+                              **{_LAYOUT_KEY: params[_LAYOUT_KEY]})
+        step = state.step + 1
+        if ef:
+            from .compress import OverlapEFState
+            new_state = OverlapEFState(new_params, opt_state, step,
+                                       ring_res[None, None],
+                                       gather_res[None, None])
+        else:
+            new_state = TrainState(new_params, opt_state, step)
+        if numerics is not None:
+            summary = numerics.summarize(
+                params, jax.tree.map(lambda x: x / M, gacc), new_params)
+            return new_state, (loss, summary)
+        return new_state, loss
+
+    return local_step
+
+
+def make_pipeline_overlap_step(cfg: LlamaConfig,
+                               optimizer: optax.GradientTransformation,
+                               mesh: Mesh, params, *,
+                               n_microbatches: int = 1,
+                               schedule: str = "gpipe", n_chunks: int = 2,
+                               aggregation: str = "zero1",
+                               wire: str = "fp32",
+                               overlap_microbatches: int = 1,
+                               numerics=None):
+    """Per-step DP×PP composition driver: ``step(state, tokens) -> (state,
+    loss)`` over a ``[n_data·B, T]`` batch sharded over ``data``, with the
+    data-axis gradient sync routed through the compressed/overlapped ring
+    (semantics in ``_make_pp_overlap_local_step``). Returns ``(state,
+    step_fn)`` — an ``OverlapEFState`` under ``wire="int8_ef"`` (EF
+    residuals in the checkpointed tree, per (data, stage) shard), a plain
+    TrainState otherwise, with ZeRO-1 moments sharded over
+    ``(data, stage)`` when ``aggregation="zero1"``."""
+    n_stages = mesh.shape["stage"]
+    body = _schedule_body(schedule, n_chunks)
+    state, state_specs, n, pad, local, total = _pp_overlap_setup(
+        optimizer, mesh, params, wire, aggregation, schedule, n_chunks)
+    has_data = mesh.shape.get("data", 1) > 1
+    local_step = _make_pp_overlap_local_step(
+        cfg, optimizer, body, n_stages=n_stages,
+        n_microbatches=n_microbatches, tp=1, n=n, pad=pad, local=local,
+        total=total, microbatches=overlap_microbatches, wire=wire,
+        aggregation=aggregation, numerics=numerics)
+    out_specs = (state_specs,
+                 ((P(), numerics.summary_specs()) if numerics is not None
+                  else P()))
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_specs, P("data") if has_data else P()),
+        out_specs=out_specs, check_vma=False)
+    return state, jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_pipeline_overlap_multi_step(cfg: LlamaConfig,
+                                     optimizer: optax.GradientTransformation,
+                                     mesh: Mesh, params, *,
+                                     n_microbatches: int = 1,
+                                     schedule: str = "gpipe",
+                                     n_chunks: int = 2,
+                                     aggregation: str = "zero1",
+                                     wire: str = "fp32",
+                                     overlap_microbatches: int = 1,
+                                     numerics=None):
+    """The DP×PP composition driver inside the K-step scan: ``step(state,
+    window) -> (state, losses)`` with ``window`` a ``[K, n_data·B, T]``
+    batch window (``shard_batch_window``) run in ONE compiled, donated
+    dispatch — ZeRO-1 moments AND int8 EF residuals ride the scan carry,
+    so error feedback is exact across fused steps, chunk-edge checkpoints
+    and a preempt/resume cycle (pinned in tests/test_pp.py). The scanned
+    body IS ``make_pipeline_overlap_step``'s, so the loss sequence and
+    final state are bitwise-identical to K per-step calls at any K."""
+    n_stages = mesh.shape["stage"]
+    body = _schedule_body(schedule, n_chunks)
+    state, state_specs, n, pad, local, total = _pp_overlap_setup(
+        optimizer, mesh, params, wire, aggregation, schedule, n_chunks)
+    has_data = mesh.shape.get("data", 1) > 1
+
+    def multi(st, window):
+        local_step = _make_pp_overlap_local_step(
+            cfg, optimizer, body, n_stages=n_stages,
+            n_microbatches=n_microbatches, tp=1, n=n, pad=pad, local=local,
+            total=total, microbatches=overlap_microbatches, wire=wire,
+            aggregation=aggregation, comm_scale=window.shape[0],
+            numerics=numerics)
+        return lax.scan(local_step, st, window)
+
+    out_specs = (state_specs,
+                 ((P(), numerics.summary_specs(stacked=True))
+                  if numerics is not None else P()))
+    sharded = shard_map(
+        multi, mesh=mesh,
+        in_specs=(state_specs, P(None, "data") if has_data else P()),
+        out_specs=out_specs, check_vma=False)
+    return state, jax.jit(sharded, donate_argnums=(0,))
+
+
+# --------------------------------------------------- stage-stacked numerics
+
+def make_pp_numerics(params, mesh: Mesh, *, psum_data: bool = False):
+    """In-jit numerics for the pipeline step bodies (the
+    ``TrainConfig.numerics_every`` lever, telemetry/introspect.py).
+
+    The DP summarizer assumes the step sees the FULL params tree; under PP
+    each shard holds only its stage's block slice, so the per-layer-group
+    geometry is built on the LOCAL stage template and the per-stage group
+    stats come back STACKED over the ``stage`` axis (shard_map out-spec
+    ``P("stage")``). Host-side, block groups are stage-qualified
+    ("stage1/blocks/0" = the second stage's first LOCAL layer; under the
+    interleaved layout, local indices follow ``interleave_params``'s
+    chunk-major order) and the stage-replicated groups (embed / head /
+    final norm — their grads are psum'd across stages by
+    ``_reduce_loss_and_grads``) are kept once, from stage 0's copy.
+
+    ``psum_data=True`` additionally psum-agrees grad stats and the finite
+    mask over ``data`` (the overlap/ring path, where local gradients
+    differ per data shard — compress.py's rule); the plain gradient path's
+    grads are already data-pmean'd, so it passes False and pays nothing.
+    Same bitwise contract as DP's: extra OUTPUTS only — losses/params are
+    identical with the summary on or off (pinned in tests/test_pp.py)."""
+    import numpy as np
+
+    from ..telemetry import introspect
+
+    if mesh.shape.get("model", 1) > 1:
+        raise ValueError("PP numerics supports model=1 meshes (per-group "
+                         "stats would differ per TP shard)")
+    n_stages = mesh.shape["stage"]
+    local_template = {
+        k: (jax.tree.map(lambda x: x[: x.shape[0] // n_stages], v)
+            if k == "blocks" else v)
+        for k, v in params.items()}
+    base = introspect.make_summarizer(
+        local_template, psum_axis="data" if psum_data else None)
+
+    def stage_expand(names, block_flags):
+        rows, cols, out = [], [], []
+        for s in range(n_stages):
+            for i, name in enumerate(names):
+                if block_flags[i]:
+                    rows.append(s)
+                    cols.append(i)
+                    out.append(f"stage{s}/{name}")
+        for i, name in enumerate(names):
+            if not block_flags[i]:
+                rows.append(0)
+                cols.append(i)
+                out.append(name)
+        return (np.asarray(rows), np.asarray(cols)), out
+
+    g_idx, groups = stage_expand(
+        base.groups, [g.startswith("blocks/") for g in base.groups])
+    l_idx, paths = stage_expand(
+        base.paths, [p.startswith("blocks/") for p in base.paths])
+
+    def summarize(params_local, grads_local, new_params_local):
+        s = base.summarize(params_local, grads_local, new_params_local)
+        # [1, G]/[1, L]: the leading axis becomes ``stage`` through the
+        # shard_map out-spec.
+        return introspect.NumericsSummary(*(x[None] for x in s))
+
+    class _PPHandle(introspect.NumericsHandle):
+        def summary_specs(self, stacked: bool = False):
+            """shard_map out-specs for the stage-stacked summary leaves:
+            ``[S, ·]`` per-step, ``[K, S, ·]`` under the K-step scan."""
+            spec = P(None, "stage") if stacked else P("stage")
+            return introspect.NumericsSummary(spec, spec, spec, spec)
+
+        def event_fields(self, summary, *, index=None, top=4):
+            def host(x):
+                a = np.asarray(x)
+                return a[index] if index is not None else a
+
+            flat = introspect.NumericsSummary(
+                grad_sq=host(summary.grad_sq)[g_idx],
+                param_sq=host(summary.param_sq)[g_idx],
+                update_sq=host(summary.update_sq)[g_idx],
+                grad_finite=host(summary.grad_finite)[l_idx])
+            return introspect.NumericsHandle.event_fields(
+                self, flat, index=None, top=top)
+
+    return _PPHandle(groups, paths, summarize)
+
+
+def shard_batch_window(mesh: Mesh, window) -> jax.Array:
+    """Device-put a [K, B, T] host batch window for the fused pipeline
+    drivers: leading axis = K consecutive steps (replicated — every shard
+    scans the same step sequence), second axis sharded over ``data`` when
+    the mesh carries a real data axis (a size-1 axis normalizes to the
+    replicated spec — the dp.data_partition jit-cache-stability rule)."""
+    spec = P(None, "data") if mesh.shape.get("data", 1) > 1 else P()
+    return jax.device_put(window, NamedSharding(mesh, spec))
 
 
 from .mesh import shard_batch  # noqa: E402,F401  (shared batch placement)

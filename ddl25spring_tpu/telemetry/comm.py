@@ -148,11 +148,14 @@ class CommProfile:
         """JSON-able shape for the run manifest / bench telemetry block.
 
         The profile's aggregates cover one traced CALL. For a fused
-        multi-step driver (parallel/dp.py ``make_multi_step``) one call is
-        one dispatch of K steps — pass ``steps_per_dispatch=K`` and the
-        dict carries the per-TRAIN-STEP normalization alongside the
-        per-dispatch totals, so "wire bytes per step" stays comparable
-        across K (the no-regression check the zero1/scan work is held to).
+        multi-step driver (parallel/dp.py ``make_multi_step``,
+        parallel/pp.py ``make_pipeline_multi_step`` and the DP×PP overlap
+        drivers — every PP collective records at ``scale=K`` through the
+        bodies' ``comm_scale``) one call is one dispatch of K steps —
+        pass ``steps_per_dispatch=K`` and the dict carries the
+        per-TRAIN-STEP normalization alongside the per-dispatch totals,
+        so "wire bytes per step" stays comparable across K (the
+        no-regression check the zero1/scan work is held to).
 
         Normalization rule (pinned in tests/test_telemetry.py so future
         drivers can't double-count): the per-train-step figures divide the

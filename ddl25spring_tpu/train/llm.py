@@ -1149,11 +1149,26 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             raise ValueError("elastic mode supports gradient and zero1 "
                              f"aggregation only (got {aggregation!r})")
         if train_cfg.wire != "fp32":
-            raise ValueError("elastic mode requires wire='fp32'")
+            raise ValueError(
+                f"elastic=True does not compose with wire="
+                f"{train_cfg.wire!r}: the compressed-wire drivers carry "
+                "per-shard error-feedback residual trees whose width is "
+                "the OLD world size, and nothing reshards them N→M on a "
+                "remesh the way the ZeRO-1 moments are "
+                "(ops/adam.py resize_zero_padded) — resuming them at the "
+                "survivors' width would silently mis-compensate "
+                "quantization error (ROADMAP item 7). Use wire='fp32' "
+                "with elastic, or drop elastic for the compressed path")
         if ovl:
-            raise ValueError("elastic mode does not compose with "
-                             "overlap_microbatches yet (nobody has taught "
-                             "the ring driver to re-mesh)")
+            raise ValueError(
+                f"elastic=True does not compose with overlap_microbatches="
+                f"{ovl} (the ring/overlap driver): its EF residual trees "
+                "(OverlapEFState.ring_residual/gather_residual) are laid "
+                "out per (shard, ring chunk) at the OLD world size, and "
+                "no remesh path reshards them N→M like the ZeRO-1 "
+                "moments — recovery would resume with stale/mis-shaped "
+                "error feedback (ROADMAP item 7). Set "
+                "overlap_microbatches=0 with elastic, or drop elastic")
         if any(s > 1 for a, s in mesh.shape.items() if a != "data"):
             raise ValueError("elastic mode supports data-axis-only meshes "
                              f"(got {dict(mesh.shape)})")
@@ -1374,6 +1389,7 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                  mesh=None,
                  tokenizer=None,
                  schedule: str = "gpipe",
+                 aggregation: str = "gradient",
                  log_every: int = 100,
                  log_fn: Callable[[str], None] = print,
                  warmup_steps_excluded: int = 2,
@@ -1395,6 +1411,31 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     data shard reads a disjoint stream window (shard_skip=5000), matching
     the reference's per-pipeline data offset.
 
+    The DP fast-path levers now compose here too (the PR 14 column):
+
+    - ``train_cfg.steps_per_dispatch`` = K > 1 drives the fused K-step
+      scan driver (pp.make_pipeline_multi_step — any schedule) through the
+      same chunked ``_run_loop`` mode as the DP trainer: one compiled,
+      donated dispatch per K steps, host work (checkpoint / StepGuard /
+      sink / telemetry / numerics sampling) quantized to chunk edges,
+      losses bitwise-identical to K=1 (tests/test_pp.py), misaligned
+      resume realigning with one smaller first chunk.
+    - ``aggregation="zero1"`` + ``train_cfg.overlap_microbatches`` = M ≥ 1
+      routes the DP×PP data-axis sync of the cross-stage-reduced gradient
+      through the compressed/overlapped ring
+      (pp.make_pipeline_overlap_*): ZeRO-1 moments sharded over
+      ``(data, stage)`` ride the scan carry, ``train_cfg.wire`` selects
+      the in-flight ring format (fp32/bf16/int8_ef — EF residuals in the
+      checkpointed state, preempt/resume bitwise).
+    - ``train_cfg.numerics_every`` emits stage-stacked in-jit numerics
+      (pp.make_pp_numerics — block groups stage-qualified, losses bitwise
+      on/off).
+
+    Still DP-trainer-only (hard errors): hierarchical DCN tiers
+    (``dcn``/``wire_dcn`` — the PP mesh has no two-level data tier),
+    elastic mode, the fused in-jit guard, and ``accum_steps`` (the
+    pipeline schedule owns its microbatching).
+
     ``checkpoint_dir`` enables orbax checkpoint/resume with stream replay,
     the same contract as train_llm_dp: restore the latest step (sharding-
     preserving — stage-sharded params land back on their stages), skip
@@ -1406,29 +1447,42 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     tok = tokenizer or load_tokenizer()
     model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
     train_cfg = train_cfg or TrainConfig()
-    if train_cfg.wire != "fp32":
-        raise ValueError("wire compression (TrainConfig.wire) is DP-trainer-"
-                         "only; the pipeline step owns its own collectives")
+    spd = train_cfg.steps_per_dispatch
+    ovl = train_cfg.overlap_microbatches
+    if spd < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
+    if ovl < 0:
+        raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
     if train_cfg.dcn != 1 or train_cfg.wire_dcn:
         raise ValueError("hierarchical DP (TrainConfig.dcn / wire_dcn) is "
                          "DP-trainer-only; the pipeline mesh has no "
                          "two-level data tier")
-    if train_cfg.overlap_microbatches != 0:
-        raise ValueError("overlap_microbatches (the ring-overlap driver) is "
-                         "DP-trainer-only; the pipeline schedule already "
-                         "owns its microbatching")
-    if train_cfg.steps_per_dispatch != 1:
-        raise ValueError("steps_per_dispatch (fused multi-step dispatch) is "
-                         "DP-trainer-only; the pipeline step owns its own "
-                         "schedule")
-    if train_cfg.numerics_every != 0:
-        raise ValueError("numerics_every (in-jit numerics summaries) is "
-                         "DP-trainer-only; the pipeline step body is not "
-                         "instrumented")
+    if train_cfg.accum_steps != 1:
+        raise ValueError("accum_steps (DP gradient accumulation) is "
+                         "DP-trainer-only: the pipeline schedule owns its "
+                         "microbatching — raise TrainConfig.microbatches "
+                         "instead")
+    if aggregation not in ("gradient", "zero1"):
+        raise ValueError(f"unknown aggregation {aggregation!r}: the PP "
+                         "trainer supports 'gradient' and 'zero1'")
+    if train_cfg.wire != "fp32" and ovl == 0:
+        raise ValueError(
+            "wire compression on the PP trainer routes through the DP×PP "
+            "ring driver: set overlap_microbatches >= 1 "
+            f"(got wire={train_cfg.wire!r} with overlap_microbatches=0)")
+    if aggregation == "zero1" and ovl == 0:
+        raise ValueError(
+            "PP zero1 routes the data-axis sync through the ring driver: "
+            "set overlap_microbatches >= 1")
     if resilience is not None and resilience.elastic:
         raise ValueError("elastic mode is DP-trainer-only: losing a replica "
                          "from a PP mesh orphans its stage partners — a "
                          "re-wiring problem, not a resharding one")
+    if resilience is not None and resilience.injit_guard:
+        raise ValueError("injit_guard is not fused into the pipeline step "
+                         "bodies — use the host StepGuard "
+                         "(ResilienceConfig.guard), which works at "
+                         "dispatch granularity under steps_per_dispatch")
     mesh = mesh or make_mesh({"data": train_cfg.data,
                               "stage": train_cfg.stage})
     n_data = mesh.shape.get("data", 1)
@@ -1438,15 +1492,55 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     if schedule == "interleaved":
         params = pp.interleave_params(params, mesh.shape["stage"],
                                       n_chunks=2)
-    state = pp.init_state(mesh, params, optimizer)
-    step_fn = pp.make_pipeline_step(model_cfg, optimizer, mesh,
-                                    n_microbatches=train_cfg.microbatches,
-                                    schedule=schedule)
-    # One compiled program per PP run — same compile/retrace accounting as
-    # the DP trainer (introspect.CompileWatch).
+    numerics = None
+    if train_cfg.numerics_every > 0:
+        # Stage-stacked in-jit numerics (pp.make_pp_numerics): block
+        # groups come back per (stage, local layer); the ring/zero1 path
+        # psum-agrees grad stats over ``data`` (local gradients differ
+        # per data shard there — the compress.py rule).
+        numerics = pp.make_pp_numerics(params, mesh, psum_data=ovl >= 1)
+
+    if ovl >= 1:
+        # DP×PP data-axis composition (pp.make_pipeline_overlap_*): the
+        # cross-stage-reduced gradient's data sync rides the
+        # compressed/overlapped ring; zero1 moments + EF residuals live
+        # in the state tree (checkpoint/preempt carry them exactly).
+        maker = (pp.make_pipeline_overlap_multi_step if spd > 1
+                 else pp.make_pipeline_overlap_step)
+        state, step_fn = maker(
+            model_cfg, optimizer, mesh, params,
+            n_microbatches=train_cfg.microbatches, schedule=schedule,
+            aggregation=aggregation, wire=train_cfg.wire,
+            overlap_microbatches=ovl, numerics=numerics)
+    elif spd > 1:
+        state = pp.init_state(mesh, params, optimizer)
+        step_fn = pp.make_pipeline_multi_step(
+            model_cfg, optimizer, mesh,
+            n_microbatches=train_cfg.microbatches, schedule=schedule,
+            numerics=numerics)
+    else:
+        state = pp.init_state(mesh, params, optimizer)
+        step_fn = pp.make_pipeline_step(
+            model_cfg, optimizer, mesh,
+            n_microbatches=train_cfg.microbatches, schedule=schedule,
+            numerics=numerics)
+    # Compile/retrace accounting (introspect.CompileWatch), the DP
+    # trainer's contract: per-step mode promises ONE compiled program;
+    # chunked mode legitimately compiles a tail-chunk shape, so no budget
+    # there — but every compile event is stamped with the COMPILING
+    # call's actual window size, so per-step MFU normalization
+    # (slo_monitor) stays honest for ragged tails.
     step_fn = introspect.watch(
-        step_fn, name=f"train/pp-{schedule}", max_caches=1,
-        events=(telemetry.events if telemetry is not None else None))
+        step_fn,
+        name=f"train/pp-{schedule}"
+             + (f"-{aggregation}" if aggregation != "gradient" else "")
+             + (f"-k{spd}" if spd > 1 else "")
+             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""),
+        max_caches=(1 if spd == 1 else None),
+        events=(telemetry.events if telemetry is not None else None),
+        meta={"steps_per_dispatch": spd},
+        meta_fn=(None if spd == 1 else
+                 (lambda st, w: {"steps_per_dispatch": int(w.shape[0])})))
     compile_watch = step_fn
 
     stats = ResilienceStats()
@@ -1457,7 +1551,9 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         return LLMTrainReport(resilience=stats)
     _emit_manifest(telemetry, trainer="pp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
-                   step_fn=step_fn, state=state, n_data=n_data)
+                   step_fn=step_fn, state=state, n_data=n_data,
+                   steps_per_dispatch=spd,
+                   overlap_microbatches=max(1, ovl))
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
@@ -1470,4 +1566,8 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                      log_fn=log_fn,
                      warmup_steps_excluded=warmup_steps_excluded,
                      stats=stats, telemetry=telemetry,
+                     steps_per_dispatch=spd,
+                     window_shard_fn=lambda w: pp.shard_batch_window(mesh, w),
+                     numerics=numerics,
+                     numerics_every=train_cfg.numerics_every,
                      compile_watch=compile_watch)

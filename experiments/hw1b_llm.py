@@ -44,16 +44,15 @@ def _run_config(name: str, iters: int, sink, provenance: str,
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
-    if topo["stage"] > 1 and (steps_per_dispatch != 1 or zero1 or elastic
-                              or numerics_every or wire != "fp32"
-                              or overlap_microbatches or dcn > 1
-                              or wire_dcn):
-        # These levers are DP-trainer-only (the PP step owns its
-        # own schedule/collectives); failing loudly beats silently timing
-        # the wrong program.
-        raise ValueError(f"--steps-per-dispatch/--zero1/--elastic/"
-                         f"--numerics-every/--wire/--overlap-microbatches/"
-                         f"--dcn/--wire-dcn need a DP config (got {name})")
+    if topo["stage"] > 1 and (elastic or dcn > 1 or wire_dcn):
+        # Still DP-trainer-only: elastic recovery (losing a replica from a
+        # PP mesh orphans its stage partners) and the hierarchical DCN
+        # tiers (the PP mesh has no two-level data axis). Everything else
+        # — --steps-per-dispatch, --zero1, --wire, --overlap-microbatches,
+        # --numerics-every — now composes on PP configs too (the PR 14
+        # column: pp.make_pipeline_multi_step / make_pipeline_overlap_*).
+        raise ValueError(f"--elastic/--dcn/--wire-dcn need a DP config "
+                         f"(got {name})")
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
                             numerics_every=numerics_every, wire=wire,
                             overlap_microbatches=overlap_microbatches,
@@ -108,12 +107,12 @@ def _run_config(name: str, iters: int, sink, provenance: str,
         telemetry = Telemetry(_os.path.join(telemetry_dir, name))
         kw["telemetry"] = telemetry
     try:
+        if zero1:
+            kw["aggregation"] = "zero1"
         if topo["stage"] > 1:
             report = train_llm_pp(model_cfg, train_cfg, log_every=log_every,
                                   **kw)
         else:
-            if zero1:
-                kw["aggregation"] = "zero1"
             report = train_llm_dp(model_cfg, train_cfg, log_every=log_every,
                                   **kw)
     finally:
@@ -229,34 +228,38 @@ if __name__ == "__main__":
     ap.add_argument("--steps-per-dispatch", type=int, default=1,
                     help="fuse K training steps into one compiled dispatch "
                          "(lax.scan over a [K, B, T] window — dp.make_multi_"
-                         "step; DP configs only; loss trajectory bit-"
-                         "identical to K=1, host work quantized to chunk "
-                         "edges)")
+                         "step / pp.make_pipeline_multi_step; loss "
+                         "trajectory bit-identical to K=1, host work "
+                         "quantized to chunk edges; works on DP AND PP "
+                         "configs)")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1 sharded weight update (dp.make_zero1_step: "
                          "reduce-scatter grads, Adam on each replica's 1/N "
-                         "slice, all-gather params; DP configs only — "
-                         "composes with --steps-per-dispatch)")
+                         "slice, all-gather params — composes with "
+                         "--steps-per-dispatch; on PP configs it shards "
+                         "the data axis of DP×PP and needs "
+                         "--overlap-microbatches >= 1)")
     ap.add_argument("--numerics-every", type=int, default=0,
                     help="in-jit numerics summaries (telemetry/"
                          "introspect.py): emit a per-layer-group "
                          "grad/param/update-norm event every N steps; "
-                         "0 disables (DP configs only; bitwise-free — "
-                         "losses identical on vs off)")
+                         "0 disables (bitwise-free — losses identical on "
+                         "vs off; PP configs get stage-stacked groups)")
     ap.add_argument("--wire", default="fp32",
                     choices=["fp32", "bf16", "int8_ef"],
                     help="gradient-sync wire format (parallel/compress.py); "
                          "composes with --zero1/--steps-per-dispatch only "
                          "through --overlap-microbatches >= 1 (the ring "
-                         "driver)")
+                         "driver; on PP configs the ring carries the "
+                         "DP×PP data-axis sync)")
     ap.add_argument("--overlap-microbatches", type=int, default=0,
                     help="ACCO-style overlapped ring driver (parallel/"
-                         "compress.py): split each step into M microbatches "
+                         "compress.py; pp.make_pipeline_overlap_* on PP "
+                         "configs): split each step into M microbatches "
                          "and overlap microbatch k+1's grad compute with "
                          "microbatch k's ppermute ring reduce-scatter, "
                          "in-flight chunks in --wire's format; 1 = "
-                         "no-split compressed ring, 0 = legacy paths; "
-                         "DP configs only")
+                         "no-split compressed ring, 0 = legacy paths")
     ap.add_argument("--dcn", type=int, default=1,
                     help="hierarchical DP: --dcn islands of --data-sized "
                          "ICI tiers bridged by DCN (hier_data_mesh); the "

@@ -1,0 +1,248 @@
+"""PP-fusion smoke: the DP×PP composition column's claims, checked (ISSUE 14).
+
+The CI-sized proof (tier1.yml) that the pipeline fast path carries the DP
+levers without hand-waving, on a 4-virtual-device ``(data=2, stage=2)``
+CPU mesh — the comm_wire_smoke contract applied to the PP column:
+
+1. the DATA-AXIS wire of the composed ``int8_ef + zero1 + scan4`` driver
+   (pp.make_pipeline_overlap_multi_step) is ≤ ~¼ of the plain DP×PP
+   step's fp32 grad pmean on the SAME model/mesh (``CommProfile.by_axis``
+   — the cross-STAGE hops are identical in both and excluded), per train
+   step;
+2. the ring + delta-gather accounting is EXACT: the profile's trips ×
+   payloads equal the analytic K·M·(n−1)·chunk_bytes (+ per-hop scale
+   sidecars, + K·(n−1)·chunk gather) formulas to the byte;
+3. zero retraces across the composition grid — wire × K at zero1 through
+   the overlap driver AND schedule × K through the plain multi-step
+   driver: each (config) compiles exactly ONE program over repeated
+   same-shape dispatches (introspect.CompileWatch), the documented
+   one-program-per-(schedule, K) factory promise;
+4. the TRAINER's compile events carry the PP window size
+   (``steps_per_dispatch`` stamped per compiling call, tail chunks with
+   their ACTUAL smaller window) so per-step MFU normalization stays
+   honest — checked end-to-end through train_llm_pp + telemetry.
+
+Wire-byte rows land in the JSON artifact in the bench_compare row shape
+({"metric": "wire_bytes_pp_data_axis_per_train_step", ...}) — the
+``wire_bytes`` prefix pins the lower-is-better direction, so the ~¼×
+compressed-wire claim is trajectory-gated exactly like DP's. Diagnostics
+live IN the JSON (the tier1 don't-clobber contract); exit 0 only when
+every check holds.
+
+    python -m experiments.pp_fusion_smoke --out pp-fusion.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run(out_path: str) -> int:
+    from ._cpu_pin import pin_cpu_virtual
+    pin_cpu_virtual()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import make_mesh, pp
+    from ddl25spring_tpu.telemetry import introspect, measure_comm
+
+    n, S, K = 2, 2, 4
+    mesh = make_mesh({"data": n, "stage": S}, devices=jax.devices()[:n * S])
+    # 4 layers: divisible by S·v = 4, so the interleaved schedule's grid
+    # entry runs on the same model as everything else.
+    cfg = LlamaConfig(vocab_size=259, dmodel=32, num_heads=2, n_layers=4,
+                      ctx_size=16)
+    opt = lambda: optax.adam(1e-3)  # noqa: E731
+
+    def fresh_params():
+        return llama.init_llama(jax.random.key(0), cfg)
+
+    bsz = 4                                    # per data shard
+    mb = 2                                     # pipeline microbatches
+    batch_sds = jax.ShapeDtypeStruct((n * bsz, cfg.ctx_size), jnp.int32)
+    window_sds = jax.ShapeDtypeStruct((K, n * bsz, cfg.ctx_size), jnp.int32)
+
+    checks, rows, profiles = {}, [], {}
+
+    # ---- baseline: plain DP×PP step, fp32 pmean on the data axis ----
+    base_state = pp.init_state(mesh, fresh_params(), opt())
+    base_step = pp.make_pipeline_step(cfg, opt(), mesh, n_microbatches=mb)
+    base_prof = measure_comm(base_step, base_state, batch_sds)
+    base_data = base_prof.by_axis()["data"]["wire_bytes_per_device"]
+    profiles["pp_f32_pmean"] = base_prof.as_dict()
+    rows.append({"metric": "wire_bytes_pp_data_axis_per_train_step",
+                 "value": base_data, "unit": "bytes/device/step",
+                 "platform": "cpu", "variant": "dp2pp2-f32-pmean"})
+
+    # ---- candidate: int8_ef + zero1 + scan4 through the DP×PP ring ----
+    cand_state, cand_step = pp.make_pipeline_overlap_multi_step(
+        cfg, opt(), mesh, fresh_params(), n_microbatches=mb,
+        aggregation="zero1", wire="int8_ef", overlap_microbatches=1)
+    cand_prof = measure_comm(cand_step, cand_state, window_sds)
+    cand_data = cand_prof.by_axis()["data"]["wire_bytes_per_device"] / K
+    profiles["pp_int8ef_zero1_scan4"] = cand_prof.as_dict(
+        steps_per_dispatch=K)
+    rows.append({"metric": "wire_bytes_pp_data_axis_per_train_step",
+                 "value": cand_data, "unit": "bytes/device/step",
+                 "platform": "cpu",
+                 "variant": "dp2pp2-int8ring+zero1+scan4"})
+
+    ratio = cand_data / base_data
+    checks["pp_data_wire_ratio"] = {
+        "value": ratio, "budget": 0.27, "ok": ratio <= 0.27,
+        "f32_pmean_bytes": base_data, "int8_ring_bytes": cand_data}
+
+    # ---- exact ring + gather accounting vs the analytic formulas ----
+    from ddl25spring_tpu.parallel.pp import _pp_flat_geometry
+    _, _, local, _ = _pp_flat_geometry(mesh, fresh_params())
+    by = cand_prof.by_label()
+    got = {"ring_payload": by["pp_ring_grad_int8"]["payload_bytes"],
+           "ring_scales": by["pp_ring_grad_scale"]["payload_bytes"],
+           "ring_wire": by["pp_ring_grad_int8"]["wire_bytes_per_device"],
+           "gather_wire":
+               by["pp_delta_gather_int8"]["wire_bytes_per_device"]}
+    want = {"ring_payload": K * 1 * (n - 1) * local,  # K·M·(n−1)·chunk int8
+            "ring_scales": K * 1 * (n - 1) * 4,       # one fp32 per hop
+            "ring_wire": K * 1 * (n - 1) * local,     # ppermute: wire==payload
+            "gather_wire": K * (n - 1) * local}       # int8 delta all-gather
+    checks["pp_ring_analytic"] = {"got": got, "want": want,
+                                  "ok": got == want}
+
+    # ---- zero retraces: wire × K grid through the overlap driver ----
+    rng = np.random.default_rng(0)
+    retraces = {}
+    for wire in ("fp32", "bf16", "int8_ef"):
+        for k in (1, 2):
+            state, step = pp.make_pipeline_overlap_multi_step(
+                cfg, opt(), mesh, fresh_params(), n_microbatches=mb,
+                aggregation="zero1", wire=wire, overlap_microbatches=1)
+            step = introspect.watch(step, name=f"smoke/pp-{wire}-k{k}",
+                                    max_caches=1)
+            window = rng.integers(
+                0, cfg.vocab_size,
+                size=(k, n * bsz, cfg.ctx_size)).astype(np.int32)
+            loss = None
+            for _ in range(3):
+                state, losses = step(state,
+                                     pp.shard_batch_window(mesh, window))
+                loss = float(np.asarray(losses)[-1])
+            retraces[f"{wire}-k{k}"] = {
+                "compiles": len(step.compiles),
+                "retraces": sum(1 for c in step.compiles if c.retrace),
+                "final_loss": loss,
+                "ok": bool(len(step.compiles) == 1
+                           and not any(c.retrace for c in step.compiles)
+                           and np.isfinite(loss))}
+    checks["overlap_retraces"] = {
+        "grid": retraces,
+        "ok": all(v["ok"] for v in retraces.values())}
+
+    # ---- zero retraces: schedule × K grid through the plain driver ----
+    # The one-program-per-(schedule, K) factory promise of
+    # make_pipeline_multi_step, for every schedule the body lookup serves.
+    sched_retraces = {}
+    for schedule in ("gpipe", "1f1b", "interleaved"):
+        params = fresh_params()
+        if schedule == "interleaved":
+            params = pp.interleave_params(params, S, 2)
+        for k in (2,):
+            state = pp.init_state(mesh, params, opt())
+            step = pp.make_pipeline_multi_step(
+                cfg, opt(), mesh, n_microbatches=mb, schedule=schedule)
+            step = introspect.watch(step,
+                                    name=f"smoke/pp-{schedule}-k{k}",
+                                    max_caches=1)
+            window = rng.integers(
+                0, cfg.vocab_size,
+                size=(k, n * bsz, cfg.ctx_size)).astype(np.int32)
+            loss = None
+            for _ in range(3):
+                state, losses = step(state,
+                                     pp.shard_batch_window(mesh, window))
+                loss = float(np.asarray(losses)[-1])
+            sched_retraces[f"{schedule}-k{k}"] = {
+                "compiles": len(step.compiles),
+                "retraces": sum(1 for c in step.compiles if c.retrace),
+                "final_loss": loss,
+                "ok": bool(len(step.compiles) == 1
+                           and not any(c.retrace for c in step.compiles)
+                           and np.isfinite(loss))}
+    checks["multi_step_retraces"] = {
+        "grid": sched_retraces,
+        "ok": all(v["ok"] for v in sched_retraces.values())}
+
+    # ---- trainer compile events carry the PP window size ----
+    # End-to-end through train_llm_pp: iters=3 at K=2 runs one full chunk
+    # and one tail chunk — two compiles, stamped 2 and 1, so slo_monitor's
+    # per-step MFU normalization cannot misread the tail as a full-K
+    # program (the DP chunked trainer's contract, tests/test_telemetry.py).
+    import os
+    import tempfile
+
+    from ddl25spring_tpu.config import TrainConfig
+    from ddl25spring_tpu.telemetry import Telemetry
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    tdir = tempfile.mkdtemp(prefix="pp-fusion-smoke-")
+    tel = Telemetry(tdir)
+    try:
+        train_llm_pp(cfg,
+                     TrainConfig(batch_size=bsz, seq_len=cfg.ctx_size,
+                                 iters=3, lr=3e-3, data=n, stage=S,
+                                 microbatches=mb, steps_per_dispatch=2),
+                     mesh=mesh, tokenizer=ByteTokenizer(), log_every=0,
+                     telemetry=tel)
+    finally:
+        tel.close()
+    compile_events = []
+    with open(os.path.join(tel.out_dir, "events.jsonl")) as f:
+        for line in f:
+            e = json.loads(line)
+            if e.get("type") == "compile" and \
+                    str(e.get("name", "")).startswith("train/pp-"):
+                compile_events.append(e)
+    # A missing stamp (the regression this gate exists to catch) must
+    # land as ok:false IN the JSON, not a TypeError sorting None.
+    stamped = sorted((e.get("steps_per_dispatch") or 0)
+                     for e in compile_events)
+    checks["trainer_compile_meta"] = {
+        "events": [{"name": e.get("name"),
+                    "steps_per_dispatch": e.get("steps_per_dispatch")}
+                   for e in compile_events],
+        "want_window_sizes": [1, 2],
+        "ok": stamped == [1, 2]}
+
+    ok = all(c["ok"] for c in checks.values())
+    doc = {"ok": ok, "n_data": n, "n_stages": S, "steps_per_dispatch": K,
+           "model": {"dmodel": cfg.dmodel, "n_layers": cfg.n_layers,
+                     "vocab": cfg.vocab_size, "ctx": cfg.ctx_size},
+           "checks": checks, "rows": rows, "profiles": profiles}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"pp-fusion smoke: data-axis ratio {ratio:.3f} (budget 0.27), "
+          f"ring accounting "
+          f"{'exact' if checks['pp_ring_analytic']['ok'] else 'WRONG'}, "
+          f"retraces {'clean' if checks['overlap_retraces']['ok'] and checks['multi_step_retraces']['ok'] else 'DIRTY'}, "
+          f"compile meta "
+          f"{'stamped' if checks['trainer_compile_meta']['ok'] else 'MISSING'} "
+          f"-> {out_path}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="pp-fusion.json")
+    a = ap.parse_args(argv)
+    return run(a.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,6 +123,14 @@ def main() -> int:
     ap.add_argument("--crash-window", type=float, default=5.0,
                     help="a nonzero exit within this many seconds of launch "
                          "counts as a crash, not a stall")
+    ap.add_argument("--poll-s", type=float, default=30.0,
+                    help="liveness-poll period: how often the child's "
+                         "progress signals are re-read while it runs. The "
+                         "default suits real training runs (a poll is a "
+                         "stat + tail read); tests tighten it so stall "
+                         "detection latency — bounded below by one poll "
+                         "tick regardless of --stall-min — doesn't "
+                         "dominate their wall time")
     ap.add_argument("--crash-loop-limit", type=int, default=3,
                     help="this many consecutive crashes -> exit "
                          f"{EXIT_CRASH_LOOP} (crash loop: the command is "
@@ -168,7 +176,7 @@ def main() -> int:
     # crashing command no longer burns all --max-restarts in seconds.
     delays = backoff_schedule(a.max_restarts, base=a.backoff_base,
                               max_delay=120.0, seed=0)
-    poll_s = 30.0
+    poll_s = a.poll_s
     consecutive_crashes = 0
     consecutive_failures = 0  # resets when a segment makes progress
     slo_enabled = a.slo_events is not None

@@ -318,3 +318,30 @@ def test_elastic_telemetry_remesh_event_and_recovery_json(tmp_path, devices):
         assert report_main([str(tmp_path / "obs")]) == 0
     out = buf.getvalue()
     assert "remesh" in out and "4 -> 3" in out
+
+
+def test_elastic_refuses_compressed_wire_and_ring_driver(devices):
+    """Satellite pin (ISSUE 14 / ROADMAP item 7): elastic=True composed
+    with the compressed-wire or ring/overlap drivers must hard-error AT
+    CONFIG TIME with a message naming the exact combination and the
+    EF-residual-reshard reason — the residual trees are laid out at the
+    OLD world size and no remesh path reshards them N→M like the ZeRO-1
+    moments, so letting the run start would be a silent wrong-answer
+    path after the first recovery, not a crash."""
+    kw = dict(mesh=_mesh(devices, 2), tokenizer=ByteTokenizer(),
+              log_every=0,
+              resilience=ResilienceConfig(elastic=True))
+    with pytest.raises(ValueError, match="error-feedback residual"):
+        train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
+                                       wire="int8_ef"), **kw)
+    with pytest.raises(ValueError, match="ring/overlap driver"):
+        train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
+                                       overlap_microbatches=1), **kw)
+    # Both messages must name the unsupported knob's value so the fix is
+    # actionable from the traceback alone.
+    with pytest.raises(ValueError, match="wire='int8_ef'"):
+        train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
+                                       wire="int8_ef"), **kw)
+    with pytest.raises(ValueError, match="overlap_microbatches=2"):
+        train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
+                                       overlap_microbatches=2), **kw)

@@ -231,6 +231,271 @@ def test_interleaved_matches_single_device_s4(devices):
     _assert_trees_close(got, jax.device_get(ref_params), 2e-5)
 
 
+# ------------------------------------------------ fused multi-step drivers
+
+def _pp_batches(n, key=1):
+    ks = jax.random.split(jax.random.key(key), n)
+    return [jax.random.randint(k, (8, CFG.ctx_size), 0, CFG.vocab_size)
+            for k in ks]
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "interleaved"])
+def test_pipeline_multi_step_bitwise_matches_per_step(devices, schedule):
+    """The acceptance bar of ISSUE 14's tentpole: the fused K-step scan
+    driver (pp.make_pipeline_multi_step) reproduces the per-step factory's
+    loss sequence AND final params BITWISE at K ∈ {1, 4} for every
+    schedule — the scanned body is literally the shared
+    _make_pp_local_step, so any drift is a bug, not re-association noise
+    (the dp.make_multi_step contract carried to the pipeline). K=1 pins
+    the degenerate window, K=4 the real fusion; both Ks share one
+    per-step reference trajectory (the factory compiles are the cost)."""
+    optimizer = lambda: optax.adam(1e-3)  # noqa: E731
+    mesh = make_mesh({"stage": 2}, devices=devices[:2])
+    batches = _pp_batches(4)
+    mb = 2
+
+    def fresh():
+        params, _ = _params_and_tokens()
+        if schedule == "interleaved":
+            params = pp.interleave_params(params, 2, 2)
+        return params
+
+    ref_state = pp.init_state(mesh, fresh(), optimizer())
+    ref_step = pp.make_pipeline_step(CFG, optimizer(), mesh, mb,
+                                     schedule=schedule)
+    ref = []
+    for b in batches:
+        ref_state, l = ref_step(ref_state, pp.shard_batch(mesh, b))
+        ref.append(float(l))
+    ref_leaves = [np.asarray(x) for x in
+                  jax.tree.leaves(jax.device_get(ref_state.params))]
+
+    for K in (1, 4):
+        state = pp.init_state(mesh, fresh(), optimizer())
+        mstep = pp.make_pipeline_multi_step(CFG, optimizer(), mesh, mb,
+                                            schedule=schedule)
+        got = []
+        for c in range(0, len(batches), K):
+            window = np.stack([np.asarray(b) for b in batches[c:c + K]])
+            state, losses = mstep(state, pp.shard_batch_window(mesh, window))
+            got.extend(float(x) for x in np.asarray(losses))
+
+        assert got == ref, K  # bitwise: same floats, same order
+        for a, b in zip(ref_leaves, jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+
+@pytest.mark.parametrize("wire", ["int8_ef"])
+def test_pipeline_overlap_multi_step_bitwise_matches_per_step(devices, wire):
+    """The DP×PP composition driver inside the K-step scan
+    (pp.make_pipeline_overlap_multi_step) reproduces the per-step overlap
+    driver bitwise at K=4 — int8 is the strict case (it additionally
+    proves the EF residual trees ((data, stage)-sharded) thread the scan
+    carry exactly; fp32/bf16 share the code path, and the fp32 ring is
+    covered against the pmean path by the smoke/trainer tests)."""
+    optimizer = lambda: optax.adam(1e-3)  # noqa: E731
+    mesh = make_mesh({"data": 2, "stage": 2}, devices=devices[:4])
+    batches = _pp_batches(4)
+
+    def fresh():
+        params, _ = _params_and_tokens()
+        return params
+
+    s1, step1 = pp.make_pipeline_overlap_step(
+        CFG, optimizer(), mesh, fresh(), n_microbatches=2,
+        aggregation="zero1", wire=wire, overlap_microbatches=1)
+    ref = []
+    for b in batches:
+        s1, l = step1(s1, pp.shard_batch(mesh, b))
+        ref.append(float(l))
+
+    sK, stepK = pp.make_pipeline_overlap_multi_step(
+        CFG, optimizer(), mesh, fresh(), n_microbatches=2,
+        aggregation="zero1", wire=wire, overlap_microbatches=1)
+    window = np.stack([np.asarray(b) for b in batches])
+    sK, losses = stepK(sK, pp.shard_batch_window(mesh, window))
+    assert [float(x) for x in np.asarray(losses)] == ref
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(sK)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pp_zero1_vs_gradient_data_axis_wire_parity(devices):
+    """ZeRO-1 on the DP×PP data axis costs the same wire as gradient
+    aggregation (the ZeRO-1 allreduce-parity claim, carried to PP): both
+    route the ring reduce-scatter plus one local-chunk gather — the delta
+    gather and the grad gather move identical bytes — so the data-axis
+    profiles must agree EXACTLY, and the losses to fp32 tolerance."""
+    from ddl25spring_tpu.telemetry import measure_comm
+
+    optimizer = lambda: optax.adam(1e-3)  # noqa: E731
+    mesh = make_mesh({"data": 2, "stage": 2}, devices=devices[:4])
+    _, tokens = _params_and_tokens()
+    sds = jax.ShapeDtypeStruct((8, CFG.ctx_size), jnp.int32)
+
+    data_wire = {}
+    losses = {}
+    for agg in ("zero1", "gradient"):
+        # Fresh params per driver: the jitted step donates its state, and
+        # the setup's device_put may alias the caller's buffers.
+        params, _ = _params_and_tokens()
+        state, step = pp.make_pipeline_overlap_step(
+            CFG, optimizer(), mesh, params, n_microbatches=2,
+            aggregation=agg, wire="int8_ef", overlap_microbatches=1)
+        prof = measure_comm(step, state, sds)
+        assert prof is not None
+        data_wire[agg] = prof.by_axis()["data"]["wire_bytes_per_device"]
+        state, loss = step(state, pp.shard_batch(mesh, tokens))
+        losses[agg] = float(loss)
+    assert data_wire["zero1"] == data_wire["gradient"]
+    np.testing.assert_allclose(losses["zero1"], losses["gradient"],
+                               rtol=1e-6)
+
+
+def test_train_llm_pp_rejects_dp_only_levers(devices):
+    """The PP trainer's validation wall: every knob the docs list as
+    DP-trainer-only must hard-error at config time, not be silently
+    ignored (accum_steps was the gap a review pass caught)."""
+    from ddl25spring_tpu.config import TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    base = dict(batch_size=2, seq_len=16, iters=2, lr=3e-3, stage=2,
+                microbatches=2)
+    kw = dict(mesh=make_mesh({"data": 1, "stage": 2}, devices=devices[:2]),
+              tokenizer=ByteTokenizer(), log_every=0)
+    with pytest.raises(ValueError, match="accum_steps"):
+        train_llm_pp(cfg, TrainConfig(**base, accum_steps=4), **kw)
+    with pytest.raises(ValueError, match="DP-trainer-only"):
+        train_llm_pp(cfg, TrainConfig(**base, dcn=2, wire_dcn="int8_ef"),
+                     **kw)
+    with pytest.raises(ValueError, match="overlap_microbatches"):
+        train_llm_pp(cfg, TrainConfig(**base, wire="int8_ef"), **kw)
+    with pytest.raises(ValueError, match="ring driver"):
+        train_llm_pp(cfg, TrainConfig(**base), aggregation="zero1", **kw)
+
+
+def test_pp_chunked_guard_skips_faulted_dispatch(devices):
+    """Chaos under PP chunked stepping (the DP dispatch-granularity test
+    mirrored, tests/test_dp.py): a nan_grad fault at dispatch 1 (steps
+    2-3 at K=2) through the full PP trainer is skipped by the StepGuard
+    at chunk granularity — exactly K consumed-not-learned steps, the
+    faulted losses visible, training finite afterwards."""
+    from ddl25spring_tpu.config import ResilienceConfig, TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    report = train_llm_pp(
+        cfg,
+        TrainConfig(batch_size=2, seq_len=16, iters=8, lr=3e-3, stage=2,
+                    microbatches=2, steps_per_dispatch=2),
+        mesh=make_mesh({"data": 1, "stage": 2}, devices=devices[:2]),
+        tokenizer=ByteTokenizer(), log_every=0,
+        resilience=ResilienceConfig(guard=True, faults="nan_grad@1"))
+    assert report.resilience.skipped_steps == 2
+    assert len(report.losses) == 8
+    assert np.isnan(report.losses[2:4]).all()    # the faulted chunk
+    assert np.isfinite(report.losses[4:]).all()  # recovered after the skip
+
+
+def test_train_llm_pp_chunked_checkpoint_resume_realigns(devices, tmp_path):
+    """PP chunked-dispatch resume: a checkpoint at a NON-chunk-aligned
+    step (iters=3 with K=2 final-saves at 3) must realign with one
+    smaller first chunk and stitch onto the per-step trajectory — the DP
+    realignment contract (tests/test_aux.py) carried to the pipeline
+    trainer."""
+    from ddl25spring_tpu.config import TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    mesh = lambda: make_mesh({"data": 1, "stage": 2},  # noqa: E731
+                             devices=devices[:2])
+    base = dict(batch_size=2, seq_len=16, lr=3e-3, stage=2, microbatches=2)
+    kw = dict(tokenizer=ByteTokenizer(), log_every=0,
+              warmup_steps_excluded=1)
+
+    full = train_llm_pp(cfg, TrainConfig(iters=6, **base), mesh=mesh(), **kw)
+    ck = str(tmp_path / "ck")
+    first = train_llm_pp(cfg,
+                         TrainConfig(iters=3, steps_per_dispatch=2, **base),
+                         mesh=mesh(), **kw, checkpoint_dir=ck,
+                         checkpoint_every=100)
+    resumed = train_llm_pp(cfg,
+                           TrainConfig(iters=6, steps_per_dispatch=2, **base),
+                           mesh=mesh(), **kw, checkpoint_dir=ck,
+                           checkpoint_every=100)
+    assert len(first.losses) == 3 and len(resumed.losses) == 3
+    assert resumed.start_step == 3
+    np.testing.assert_allclose(first.losses + resumed.losses, full.losses,
+                               rtol=2e-5)
+
+
+def test_pp_overlap_ef_residual_exact_through_preempt_resume(devices):
+    """The acceptance bar: a DP×PP int8+EF overlap run (zero1, K=2)
+    interrupted at a chunk edge and resumed from its checkpoint walks
+    BITWISE the uninterrupted trajectory — possible only if the
+    (data, stage)-sharded EF residual trees restore exactly through the
+    checkpointed OverlapEFState."""
+    import tempfile
+
+    from ddl25spring_tpu.config import TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    base = dict(batch_size=2, seq_len=16, lr=3e-3, stage=2, microbatches=2,
+                data=2, wire="int8_ef", overlap_microbatches=1,
+                steps_per_dispatch=2)
+    mesh = lambda: make_mesh({"data": 2, "stage": 2},  # noqa: E731
+                             devices=devices[:4])
+
+    ref = train_llm_pp(cfg, TrainConfig(**base, iters=6), mesh=mesh(),
+                       tokenizer=ByteTokenizer(), log_every=0,
+                       aggregation="zero1")
+    d = tempfile.mkdtemp()
+    a = train_llm_pp(cfg, TrainConfig(**base, iters=4), mesh=mesh(),
+                     tokenizer=ByteTokenizer(), log_every=0,
+                     aggregation="zero1", checkpoint_dir=d,
+                     checkpoint_every=100)
+    b = train_llm_pp(cfg, TrainConfig(**base, iters=6), mesh=mesh(),
+                     tokenizer=ByteTokenizer(), log_every=0,
+                     aggregation="zero1", checkpoint_dir=d,
+                     checkpoint_every=100)
+    assert a.losses + b.losses == ref.losses
+    assert np.isfinite(ref.losses).all()
+
+
+def test_pp_numerics_bitwise_on_off(devices):
+    """The PP numerics contract (pp.make_pp_numerics): stage-stacked
+    in-jit summaries are extra OUTPUTS only — the loss trajectory is
+    bitwise identical with instrumentation on vs off, on both the plain
+    and the ring/zero1 paths."""
+    from ddl25spring_tpu.config import TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_pp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    mesh = lambda: make_mesh({"data": 2, "stage": 2},  # noqa: E731
+                             devices=devices[:4])
+    # The ring/zero1 path is the strict case (psum-agreed grad stats over
+    # ``data``); the plain path shares the extra-outputs-only contract.
+    base = dict(batch_size=2, seq_len=16, iters=4, lr=3e-3, stage=2,
+                microbatches=2, data=2, wire="int8_ef",
+                overlap_microbatches=1)
+    kw = dict(mesh=mesh(), tokenizer=ByteTokenizer(), log_every=0,
+              aggregation="zero1")
+    off = train_llm_pp(cfg, TrainConfig(**base), **kw)
+    on = train_llm_pp(cfg, TrainConfig(**base, numerics_every=2), **kw)
+    assert on.losses == off.losses
+
+
 def test_pp_chaos_nan_grad_at_dispatch_guarded_run_completes(devices):
     """Chaos coverage for the PP path (mirroring the DP dispatch-
     granularity skip test, tests/test_dp.py): a ``nan_grad`` fault at

@@ -41,7 +41,7 @@ def test_watchdog_kills_stall_and_resumes(tmp_path):
     prog = tmp_path / "progress.csv"
     proc = subprocess.run(
         [sys.executable, "-m", "experiments.watchdog",
-         "--progress", str(prog), "--stall-min", "0.02",
+         "--progress", str(prog), "--stall-min", "0.02", "--poll-s", "1",
          "--dedupe-keys", "iter", "--max-restarts", "3", "--",
          sys.executable, str(fake), str(tmp_path)],
         cwd=REPO, capture_output=True, text=True, timeout=300)
