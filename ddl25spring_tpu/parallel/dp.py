@@ -578,11 +578,26 @@ def reshard_state(host_state, template_state):
     ``P("data")`` sharding is the rescatter. Zero-pad-tail violations are
     a hard error there, not silent truncation.
 
+    ``OverlapEFState`` snapshots (the int8-ring drivers) reshard too: the
+    1-D ``gather_residual`` [Ppad] is pad-swapped by the flat-vector rule
+    above (pad coordinates carry zero error — quantizing an exactly-zero
+    pad is exact — so the zero-tail check holds), and the 2-D
+    ``ring_residual`` [n, ring_len] goes through ``_resize_ring_residual``
+    row-wise before the leaf pass. That is what lets elastic mode compose
+    with compressed wire (ROADMAP 7c).
+
     Value-exact by construction: every surviving coordinate is a bitwise
     copy, so a trajectory continued from the resharded state is the
     trajectory of a fresh M-way run initialized from the same snapshot
     (asserted in tests/test_elastic.py)."""
     from ..ops.adam import resize_zero_padded
+
+    if (hasattr(host_state, "ring_residual")
+            and hasattr(template_state, "ring_residual")):
+        host_state = host_state._replace(
+            ring_residual=_resize_ring_residual(
+                np.asarray(host_state.ring_residual),
+                tuple(template_state.ring_residual.shape)))
 
     def leaf(h, t):
         if not isinstance(t, jax.Array):
@@ -593,6 +608,44 @@ def reshard_state(host_state, template_state):
         return jax.device_put(h, t.sharding)
 
     return jax.tree.map(leaf, host_state, template_state)
+
+
+def _resize_ring_residual(h: np.ndarray, new_shape) -> np.ndarray:
+    """Resize an int8-ring EF ``ring_residual`` [n_old, ring_len_old] to a
+    new data-parallel world's [n_new, ring_len_new] — the per-(shard,chunk)
+    generalization of ``resize_zero_padded``'s pad swap.
+
+    Row r is shard r's per-coordinate pending quantization error over the
+    flat padded vector, so each surviving row pad-swaps exactly like a
+    ZeRO-1 moment slice stack (tail coordinates sit in the zero pad, where
+    quantization error is exactly zero — nonzero tails hard-error, same
+    contract). New rows (grow) start at zero error like a fresh shard's.
+    Each row's OWN-chunk slice is re-zeroed in the NEW geometry: the owner
+    never quantizes its own chunk (its contribution is added in fp32), so
+    the slot is structurally zero — but the chunk boundaries moved with
+    ``n``, and coordinates that used to belong to another shard's chunk may
+    land in the own-chunk slot carrying old error the ring would never
+    read or clear.
+
+    Dropped rows (shrink) carry the dead shards' pending corrections —
+    bounded by one int8 quantization step per coordinate — and are lost
+    with the topology, exactly as the dead shards' unsent partials are.
+    Both recovery paths (mirror and checkpoint) route through here, so the
+    post-remesh trajectory still bitwise-matches a fresh run restored from
+    the same snapshot."""
+    from ..ops.adam import resize_zero_padded
+
+    n_new, len_new = int(new_shape[0]), int(new_shape[1])
+    n_old, _ = h.shape
+    if len_new % n_new:
+        raise ValueError(f"ring_len {len_new} is not a multiple of the "
+                         f"data world {n_new} — not a flat-ring residual")
+    local_new = len_new // n_new
+    out = np.zeros((n_new, len_new), h.dtype)
+    for r in range(min(n_old, n_new)):
+        out[r] = resize_zero_padded(np.asarray(h[r]), len_new)
+        out[r, r * local_new:(r + 1) * local_new] = 0.0
+    return out
 
 
 def host_snapshot(state):

@@ -85,6 +85,49 @@ def survivor_submesh(mesh: Mesh, lost: Sequence[int]) -> Mesh:
     return Mesh(np.asarray(devices), ("data",))
 
 
+def rejoin_mesh(mesh: Mesh, returned: Sequence, *,
+                pool: Optional[Sequence] = None) -> Mesh:
+    """The mesh after previously-lost devices come back — the scale-UP
+    inverse of ``survivor_submesh`` (resilience/elastic.py's grow path).
+
+    ``returned`` is the device objects rejoining. ``pool`` is the run's
+    original full device list: when given, the merged devices are ordered
+    by their pool positions, so a shrink followed by a full rejoin
+    reconstructs the original device order exactly — which is what makes a
+    4→3→4 trajectory comparable to a fresh 4-replica run on
+    ``jax.devices()[:4]`` (the bitwise bar in tests/test_elastic.py).
+    Without ``pool`` the returned devices append at the end.
+
+    Same data-axis-only restriction as ``survivor_submesh``, and rejoining
+    a device already in the mesh is a hard error (a duplicate device would
+    alias two replicas onto one chip and silently halve real throughput)."""
+    for name, size in mesh.shape.items():
+        if name != "data" and size > 1:
+            raise ValueError(
+                f"rejoin_mesh supports data-axis-only meshes; "
+                f"axis {name!r} has size {size}")
+    returned = list(returned)
+    if not returned:
+        raise ValueError("rejoin_mesh needs at least one returned device")
+    if len(set(returned)) != len(returned):
+        raise ValueError(f"returned devices contain duplicates: {returned}")
+    current = list(mesh.devices.flatten())
+    for d in returned:
+        if d in current:
+            raise ValueError(f"device {d} is already in the mesh — "
+                             "rejoining it would alias two replicas")
+    devices = current + returned
+    if pool is not None:
+        index = {d: i for i, d in enumerate(pool)}
+        missing = [d for d in devices if d not in index]
+        if missing:
+            raise ValueError(f"devices {missing} are not in the original "
+                             "pool — rejoin_mesh can only restore capacity "
+                             "the run started with")
+        devices = sorted(devices, key=lambda d: index[d])
+    return Mesh(np.asarray(devices), ("data",))
+
+
 def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 

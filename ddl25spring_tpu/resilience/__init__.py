@@ -15,9 +15,17 @@ clients):
 - ``elastic``    — ``ElasticController``: replica loss (``device_loss``
                    faults → ``ReplicaLossError``) → drain at the chunk
                    edge, re-mesh onto the survivors, reshard params +
-                   ZeRO-1 optimizer state N→M, re-split the stream,
-                   resume — from a host-RAM mirror (fast) or the
-                   checkpoint (slow).
+                   ZeRO-1 optimizer state (and int8-ring EF residuals)
+                   N→M, re-split the stream, resume — from a host-RAM
+                   mirror (fast) or the checkpoint (slow). Bidirectional:
+                   returned capacity (``device_return`` faults →
+                   ``ReplicaReturnSignal``, or an autoscaler decision)
+                   grows M→N through the same machinery.
+- ``autoscale``  — ``Autoscaler``: SLO-driven policy loop moving replicas
+                   between the training mesh and the serving fleet
+                   (sustained TTFT pressure → shrink training, hand the
+                   chips to serving; traffic ebb → reverse), emitting
+                   schema-v8 ``scale`` events.
 
 Counters land in ``metrics.ResilienceStats``; knobs in
 ``config.ResilienceConfig``. Wire-ins: train/llm.py (guarded loops),
@@ -26,10 +34,13 @@ guard), checkpoint.py (corrupt-step fallback, atomic best-weights),
 experiments/watchdog.py (crash-loop-aware relaunch backoff).
 """
 
+from .autoscale import (Autoscaler, AutoscalePolicy,  # noqa: F401
+                        ScaleDecision)
 from .elastic import (ElasticController, RemeshRecord,  # noqa: F401
                       Resume)
 from .faults import (FaultEvent, FaultPlan, ReplicaLossError,  # noqa: F401
-                     corrupt_latest_checkpoint, parse_spec)
+                     ReplicaReturnSignal, corrupt_latest_checkpoint,
+                     parse_spec)
 from .preemption import PreemptionHandler  # noqa: F401
 from .retry import backoff_schedule, retry_call, with_retry  # noqa: F401
 
@@ -38,10 +49,12 @@ from .retry import backoff_schedule, retry_call, with_retry  # noqa: F401
 # Load it lazily (PEP 562) so jax-free supervisors — experiments/watchdog.py
 # pulling in backoff_schedule — don't pay jax's import time and memory.
 _GUARD_EXPORTS = ("StepGuard", "measure_overhead")
-__all__ = ["ElasticController", "FaultEvent", "FaultPlan", "RemeshRecord",
-           "ReplicaLossError", "Resume", "corrupt_latest_checkpoint",
-           "parse_spec", "PreemptionHandler", "backoff_schedule",
-           "retry_call", "with_retry", *_GUARD_EXPORTS]
+__all__ = ["Autoscaler", "AutoscalePolicy", "ElasticController",
+           "FaultEvent", "FaultPlan", "RemeshRecord", "ReplicaLossError",
+           "ReplicaReturnSignal", "Resume", "ScaleDecision",
+           "corrupt_latest_checkpoint", "parse_spec", "PreemptionHandler",
+           "backoff_schedule", "retry_call", "with_retry",
+           *_GUARD_EXPORTS]
 
 
 def __getattr__(name):

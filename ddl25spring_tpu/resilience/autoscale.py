@@ -1,0 +1,217 @@
+"""SLO-driven autoscaler: the policy half of the elasticity control plane.
+
+The mechanism half already exists — the trainer can shrink AND grow its
+data mesh at a chunk edge with bitwise-reproducible state
+(resilience/elastic.py ``ElasticController.resize``), and the serving
+fleet can activate/drain engines without dropping a stream
+(serving/fleet.py ``ServingFleet.set_active``). This module decides WHEN
+to move capacity between the two, from the same signal
+experiments/slo_monitor.py issues verdicts over: the rolling-window TTFT
+the fleet's router already keeps per engine.
+
+Policy (``AutoscalePolicy``), deliberately boring:
+
+====================  ====================================================
+signal                action
+====================  ====================================================
+p95 TTFT >= pressure  sustained ``sustain`` ticks -> move ``step`` replicas
+(pressure_frac·SLO)   train -> serve (drain training at the chunk edge,
+                      shrink the mesh, activate engines)
+p95 TTFT <= ebb       sustained ``sustain`` ticks -> move ``step`` engines
+(ebb_frac·SLO), or    serve -> train (drain engines, grow the mesh)
+no traffic at all
+====================  ====================================================
+
+Two properties make the smoke's "zero SLO violations" bar honest rather
+than lucky:
+
+- The scale-out trigger fires at ``pressure_frac`` (default 0.8) of the
+  SLO, BELOW the violation threshold — capacity arrives while requests
+  are still inside their budget, not after they have missed it.
+- ``cooldown`` ticks of enforced inaction after every move stop the
+  classic autoscaler failure mode (flapping: the post-move window still
+  holds pre-move samples, which would immediately re-trigger).
+
+``Autoscaler.tick`` is a pure policy step: it reads one measurement and
+returns a ``ScaleDecision`` (or None). It never touches the trainer or
+the fleet — the caller wires decisions into ``train_llm_dp``'s
+``scale_hook`` and ``ServingFleet.set_active``
+(experiments/autoscale_smoke.py is the reference wiring). Keeping the
+loop mechanism-free means it is trivially deterministic: same
+measurement sequence -> same decision sequence, which is what lets the
+smoke pin its scale trajectory.
+
+Telemetry (schema v8): every decision emits one ``scale`` event carrying
+the POST-transition allocation plus the triggering signal and value —
+experiments/obs_report.py renders the section, trace_export.py drops
+instant markers on the Perfetto timeline.
+
+This module is imported at ``resilience`` package scope and therefore
+must stay jax-free (stdlib + dataclasses only).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional
+
+from ..telemetry.events import EventLog
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """Thresholds and guard rails for ``Autoscaler``.
+
+    ``ttft_slo_s`` is the serving SLO the whole loop protects (same
+    number slo_monitor's ``--ttft`` takes). ``pressure_frac`` /
+    ``ebb_frac`` scale it into the scale-out / scale-in trigger lines;
+    pressure MUST be < 1.0 or the trigger only fires after a violation
+    has already happened. ``sustain`` consecutive ticks must agree before
+    a move; ``cooldown`` ticks are skipped after one. ``step`` replicas
+    move per decision. The ``min_``/``max_`` bounds are hard walls — a
+    decision that would cross one is simply not made (training never
+    drains below ``min_train_world``; serving never below
+    ``min_serve_engines``)."""
+
+    ttft_slo_s: float
+    max_train_world: int
+    max_serve_engines: int
+    pressure_frac: float = 0.8
+    ebb_frac: float = 0.3
+    sustain: int = 2
+    cooldown: int = 2
+    min_train_world: int = 1
+    min_serve_engines: int = 1
+    step: int = 1
+
+    def __post_init__(self):
+        if not self.ttft_slo_s > 0:
+            raise ValueError(f"ttft_slo_s={self.ttft_slo_s} must be > 0")
+        if not 0 < self.pressure_frac < 1:
+            raise ValueError(
+                f"pressure_frac={self.pressure_frac} must be in (0, 1) — "
+                "at >= 1 the autoscaler only reacts AFTER an SLO violation")
+        if not 0 <= self.ebb_frac < self.pressure_frac:
+            raise ValueError(
+                f"ebb_frac={self.ebb_frac} must be in [0, pressure_frac) — "
+                "overlapping bands would scale both ways on one signal")
+        if self.sustain < 1 or self.cooldown < 0 or self.step < 1:
+            raise ValueError(
+                f"sustain={self.sustain} (>=1), cooldown={self.cooldown} "
+                f"(>=0), step={self.step} (>=1)")
+        if not 1 <= self.min_train_world <= self.max_train_world:
+            raise ValueError(
+                f"need 1 <= min_train_world={self.min_train_world} <= "
+                f"max_train_world={self.max_train_world}")
+        if not 1 <= self.min_serve_engines <= self.max_serve_engines:
+            raise ValueError(
+                f"need 1 <= min_serve_engines={self.min_serve_engines} <= "
+                f"max_serve_engines={self.max_serve_engines}")
+
+
+class ScaleDecision(NamedTuple):
+    """One capacity move, POST-transition allocation (matches the
+    ``scale`` telemetry event's required fields)."""
+
+    direction: str      # "train_to_serve" | "serve_to_train"
+    train_world: int    # training data-parallel world AFTER the move
+    serve_engines: int  # active serving engines AFTER the move
+    signal: str         # "ttft_pressure" | "traffic_ebb"
+    value: float        # the p95 TTFT that triggered it (0.0 for idle)
+
+
+class Autoscaler:
+    """Streak-and-cooldown policy loop over a TTFT measurement feed.
+
+    Holds the control plane's view of the allocation (``train_world``,
+    ``serve_engines``); ``tick`` advances it. The caller is responsible
+    for actually applying each returned ``ScaleDecision`` — the loop
+    assumes every decision it makes lands (experiments/autoscale_smoke.py
+    applies them at the trainer's next chunk edge via ``scale_hook``, so
+    the view and the mesh agree at every decision point)."""
+
+    def __init__(self, policy: AutoscalePolicy, *, train_world: int,
+                 serve_engines: int, events: Optional[EventLog] = None,
+                 log_fn=print):
+        p = policy
+        if not p.min_train_world <= train_world <= p.max_train_world:
+            raise ValueError(f"train_world={train_world} outside policy "
+                             f"[{p.min_train_world}, {p.max_train_world}]")
+        if not p.min_serve_engines <= serve_engines <= p.max_serve_engines:
+            raise ValueError(f"serve_engines={serve_engines} outside policy "
+                             f"[{p.min_serve_engines}, {p.max_serve_engines}]")
+        self.policy = p
+        self.train_world = int(train_world)
+        self.serve_engines = int(serve_engines)
+        self.decisions: List[ScaleDecision] = []
+        self.events = events
+        self.log_fn = log_fn
+        self._hot = 0       # consecutive ticks at/above the pressure line
+        self._ebb = 0       # consecutive ticks at/below the ebb line
+        self._cool = 0      # ticks of enforced inaction remaining
+
+    def tick(self, ttft_p95_s: Optional[float],
+             it: Optional[int] = None) -> Optional[ScaleDecision]:
+        """One policy step. ``ttft_p95_s`` is the current rolling p95 TTFT
+        (None = no completed requests in the window, which reads as ebb:
+        an idle fleet is over-provisioned by definition). ``it`` tags the
+        telemetry event with the training iteration. Returns the decision
+        to apply, or None."""
+        p = self.policy
+        hot = (ttft_p95_s is not None
+               and ttft_p95_s >= p.pressure_frac * p.ttft_slo_s)
+        ebb = (ttft_p95_s is None
+               or ttft_p95_s <= p.ebb_frac * p.ttft_slo_s)
+        # Streaks accumulate THROUGH cooldown (pressure that persists
+        # across a move should act the first tick cooldown expires), but
+        # decisions do not.
+        self._hot = self._hot + 1 if hot else 0
+        self._ebb = self._ebb + 1 if ebb else 0
+        if self._cool > 0:
+            self._cool -= 1
+            return None
+        if (self._hot >= p.sustain
+                and self.train_world - p.step >= p.min_train_world
+                and self.serve_engines + p.step <= p.max_serve_engines):
+            decision = ScaleDecision(
+                "train_to_serve", self.train_world - p.step,
+                self.serve_engines + p.step, "ttft_pressure",
+                float(ttft_p95_s))
+        elif (self._ebb >= p.sustain
+                and self.serve_engines - p.step >= p.min_serve_engines
+                and self.train_world + p.step <= p.max_train_world):
+            decision = ScaleDecision(
+                "serve_to_train", self.train_world + p.step,
+                self.serve_engines - p.step, "traffic_ebb",
+                0.0 if ttft_p95_s is None else float(ttft_p95_s))
+        else:
+            return None
+        self.train_world = decision.train_world
+        self.serve_engines = decision.serve_engines
+        self._hot = self._ebb = 0
+        self._cool = p.cooldown
+        self.decisions.append(decision)
+        if self.events is not None:
+            self.events.scale(direction=decision.direction,
+                              train_world=decision.train_world,
+                              serve_engines=decision.serve_engines,
+                              signal=decision.signal, value=decision.value,
+                              **({} if it is None else {"it": int(it)}))
+        if self.log_fn is not None:
+            self.log_fn(f"[autoscale] {decision.direction} on "
+                        f"{decision.signal} (p95 ttft "
+                        f"{decision.value * 1e3:.1f} ms vs slo "
+                        f"{p.ttft_slo_s * 1e3:.1f} ms) -> train_world="
+                        f"{decision.train_world} serve_engines="
+                        f"{decision.serve_engines}")
+        return decision
+
+
+def router_ttft_p95(router) -> Optional[float]:
+    """Current fleet-wide p95 TTFT from a serving ``Router``'s per-engine
+    rolling windows (the same windows ``predicted_ttft`` routing reads).
+    None when no window holds a sample. Call ``router.harvest(now)``
+    first to fold freshly completed requests in and expire old ones."""
+    from ..telemetry.registry import percentile
+    vals = [ttft for window in router._ttft for _, ttft in window]
+    return percentile(vals, 95.0) if vals else None

@@ -31,12 +31,25 @@ recovery position (a fresh M-replica run's data order, exactly), and the
 step function is rebuilt at the new world size with fault/guard wrappers
 re-applied at the absolute dispatch index.
 
+Bidirectional: the same machinery runs in REVERSE when capacity comes
+back. ``device_return`` faults (→ ``ReplicaReturnSignal``) or an
+autoscaler decision (``resize``, resilience/autoscale.py) grow M→N
+through ``parallel.mesh.rejoin_mesh`` — devices re-enter at their
+original pool order, the mirror/checkpoint state reshards UP (the same
+``reshard_state`` pad-swap, run toward more shards), the stream re-splits
+at width N, and the fault wrapper resumes at the absolute dispatch index.
+Shrink and grow are one code path (``_remesh``) differing only in how the
+new mesh is chosen.
+
 Correctness bar (pinned in tests/test_elastic.py): bitwise. Zero faults →
 the elastic loop's losses equal the non-elastic path's; after an N→M
-shrink the continued trajectory equals a fresh M-replica run restored
-from the same state.
+shrink (or an M→N grow) the continued trajectory equals a fresh M- (N-)
+replica run restored from the same state — both directions, both
+recovery paths.
 
-Scope: DP-only meshes (gradient / zero1 aggregation). Losing a replica
+Scope: DP-only meshes (gradient / zero1 aggregation — plus the int8-ring
+overlap drivers, whose EF residual trees reshard alongside the ZeRO-1
+moments via ``reshard_state``'s ring-residual pre-pass). Losing a replica
 from a DPxPP/DPxTP mesh orphans the victim's stage/model partners — a
 re-wiring problem, not a resharding one — and is rejected loudly
 (``parallel.mesh.survivor_submesh``).
@@ -50,24 +63,26 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, List, NamedTuple, Optional, Tuple
 
 from ..telemetry.trace import Tracer
-from .faults import ReplicaLossError
+from .faults import ReplicaLossError, ReplicaReturnSignal
 
 
 @dataclass
 class RemeshRecord:
-    """Accounting for one replica-loss recovery — lands in
+    """Accounting for one topology change (shrink OR grow) — lands in
     ``LLMTrainReport.remeshes``, the telemetry ``remesh`` event, and the
-    elastic smoke's recovery JSON."""
+    elastic/autoscale smokes' recovery JSON."""
 
-    detected_at: int       # stream position of the failed dispatch
+    detected_at: int       # stream position of the interrupted dispatch
     resume_step: int       # stream position training resumed from
-    dispatch: int          # absolute dispatch index of the failure
+    dispatch: int          # absolute dispatch index of the interruption
     old_world: int
     new_world: int
     lost: List[int] = field(default_factory=list)
     path: str = "mirror"   # "mirror" (host-RAM fast path) | "checkpoint"
     seconds: float = 0.0   # drain → resharded-and-replayed wall time
     steps_replayed: int = 0  # detected_at - resume_step (re-trained steps)
+    direction: str = "shrink"   # "shrink" | "grow"
+    returned: List[int] = field(default_factory=list)  # rejoined pool slots
 
     def as_dict(self) -> dict:
         return {"detected_at": self.detected_at,
@@ -76,7 +91,9 @@ class RemeshRecord:
                 "old_world": self.old_world, "new_world": self.new_world,
                 "lost": list(self.lost), "path": self.path,
                 "seconds": self.seconds,
-                "steps_replayed": self.steps_replayed}
+                "steps_replayed": self.steps_replayed,
+                "direction": self.direction,
+                "returned": list(self.returned)}
 
 
 class Resume(NamedTuple):
@@ -123,6 +140,11 @@ class ElasticController:
                  make_batches: Callable, ckpt=None, mirror_every: int = 1,
                  stats=None, telemetry=None, log_fn: Callable = print):
         self.mesh = mesh
+        # The run's original full device pool: the grow path can only
+        # restore capacity the run started with, and pool order is what
+        # makes a full shrink-then-grow round trip land devices back in
+        # their original replica slots (the 4→3→4 bitwise bar).
+        self._pool = list(mesh.devices.flatten())
         self._build = build
         self._rewrap = rewrap
         self._make_batches = make_batches
@@ -161,6 +183,12 @@ class ElasticController:
 
     # ----------------------------------------------------------- recovery
 
+    def absent(self) -> List[int]:
+        """Pool positions of devices currently OUT of the mesh — the
+        capacity a grow can reclaim. Empty until a shrink happens."""
+        current = set(self.mesh.devices.flatten())
+        return [i for i, d in enumerate(self._pool) if d not in current]
+
     def recover(self, err: ReplicaLossError, *, failed_at: int,
                 dispatch: int) -> Resume:
         """Re-mesh onto the survivors and hand back a resumable world.
@@ -170,10 +198,8 @@ class ElasticController:
         the rebuilt fault wrapper continues the schedule from
         ``dispatch + 1``, so already-delivered faults never re-fire and
         later-scheduled ones keep their absolute positions."""
-        from ..parallel import dp
         from ..parallel.mesh import survivor_submesh
 
-        t0 = time.perf_counter()
         old_world = int(self.mesh.shape["data"])
         lost = err.victims(old_world)
         if not lost:
@@ -183,14 +209,115 @@ class ElasticController:
             # onto the dead replica itself.
             raise err
         new_mesh = survivor_submesh(self.mesh, lost)
-        new_world = old_world - len(lost)
         self._log(f"replica loss at step {failed_at} (dispatch {dispatch}): "
                   f"lost {lost} of {old_world}; re-meshing onto "
-                  f"{new_world} survivors")
+                  f"{old_world - len(lost)} survivors")
+        return self._remesh(new_mesh, failed_at=failed_at, dispatch=dispatch,
+                            lost=lost, returned=[], direction="shrink",
+                            err=err)
+
+    def grow(self, sig: ReplicaReturnSignal, *, failed_at: int,
+             dispatch: int) -> Resume:
+        """Scale-UP re-mesh: previously-lost capacity came back. The
+        signal's seeded ``arrivals`` picks which absent pool slots rejoin;
+        the new mesh restores pool order (``rejoin_mesh``), state reshards
+        M→N through the same mirror/checkpoint paths as ``recover``, and
+        the same bitwise bar applies (post-grow losses == a fresh N-replica
+        run restored from the same state)."""
+        from ..parallel.mesh import rejoin_mesh
+
+        old_world = int(self.mesh.shape["data"])
+        absent = self.absent()
+        arrivals = sig.arrivals(absent)
+        if not arrivals:
+            raise RuntimeError(
+                f"device_return at dispatch {dispatch}: no capacity is "
+                f"absent (world {old_world}, pool {len(self._pool)}) — a "
+                "return must follow a loss; fix the chaos spec") from sig
+        returned = [self._pool[i] for i in arrivals]
+        new_mesh = rejoin_mesh(self.mesh, returned, pool=self._pool)
+        self._log(f"replica return at step {failed_at} "
+                  f"(dispatch {dispatch}): pool slots {arrivals} rejoin; "
+                  f"re-meshing onto {old_world + len(arrivals)} replicas")
+        return self._remesh(new_mesh, failed_at=failed_at, dispatch=dispatch,
+                            lost=[], returned=arrivals, direction="grow",
+                            err=sig)
+
+    def resize(self, new_world: int, *, state, at_step: int,
+               dispatch: int) -> Optional[Resume]:
+        """Capacity-change re-mesh (NOT fault-triggered): the autoscaler's
+        entry point. Shrinks release the highest-indexed replicas (their
+        devices become ``absent`` capacity another tenant can use); grows
+        reclaim absent pool slots lowest-first. Returns None when the mesh
+        is already at ``new_world`` — a no-op resize must not cost a
+        reshard.
+
+        ``state`` is the state the loop just drained at chunk edge
+        ``at_step``: it is snapshotted as the mirror HERE, so the resize
+        resumes from exactly this position — zero steps replayed, zero
+        lost — regardless of the mirror cadence. Call only between
+        dispatches (the drain-at-chunk-edge contract)."""
+        from ..parallel import dp
+        from ..parallel.mesh import rejoin_mesh, survivor_submesh
+
+        old_world = int(self.mesh.shape["data"])
+        new_world = int(new_world)
+        if new_world == old_world:
+            return None
+        # A capacity change is planned, not a failure: the just-drained
+        # state IS last-good, and pinning the mirror at the edge makes
+        # resume_step == at_step (steps_replayed == 0) by construction.
+        self._mirror = (at_step, dp.host_snapshot(state))
+        if new_world < 1:
+            raise ValueError(f"resize to {new_world} replicas: the training "
+                             "mesh cannot shrink below 1")
+        if new_world > len(self._pool):
+            raise ValueError(f"resize to {new_world} replicas exceeds the "
+                             f"run's device pool ({len(self._pool)})")
+        if new_world < old_world:
+            lost = list(range(new_world, old_world))
+            new_mesh = survivor_submesh(self.mesh, lost)
+            self._log(f"resize at step {at_step}: releasing replicas "
+                      f"{lost} ({old_world} -> {new_world})")
+            return self._remesh(new_mesh, failed_at=at_step,
+                                dispatch=dispatch, lost=lost, returned=[],
+                                direction="shrink",
+                                err=RuntimeError(
+                                    f"resize {old_world}->{new_world} at "
+                                    f"step {at_step} found no recoverable "
+                                    "state (no mirror, no checkpoint)"))
+        arrivals = self.absent()[:new_world - old_world]
+        if len(arrivals) < new_world - old_world:
+            raise ValueError(f"resize to {new_world} replicas: only "
+                             f"{len(arrivals)} pool slots are absent")
+        returned = [self._pool[i] for i in arrivals]
+        new_mesh = rejoin_mesh(self.mesh, returned, pool=self._pool)
+        self._log(f"resize at step {at_step}: pool slots {arrivals} "
+                  f"rejoin ({old_world} -> {new_world})")
+        return self._remesh(new_mesh, failed_at=at_step, dispatch=dispatch,
+                            lost=[], returned=arrivals, direction="grow",
+                            err=RuntimeError(
+                                f"resize {old_world}->{new_world} at step "
+                                f"{at_step} found no recoverable state "
+                                "(no mirror, no checkpoint)"))
+
+    def _remesh(self, new_mesh, *, failed_at: int, dispatch: int,
+                lost: List[int], returned: List[int], direction: str,
+                err: BaseException) -> Resume:
+        """The shared drain → re-mesh → reshard → replay → resume machinery
+        behind ``recover`` (shrink), ``grow`` and ``resize`` (either way).
+        ``err`` is raised back when recovery is impossible (no mirror AND
+        no restorable checkpoint)."""
+        from ..parallel import dp
+
+        t0 = time.perf_counter()
+        old_world = int(self.mesh.shape["data"])
+        new_world = int(new_mesh.shape["data"])
         self._beat(failed_at, "remesh")
         rroot = (self._tracer.start("remesh", trace="train", it=failed_at,
                                     old_world=old_world,
-                                    new_world=new_world)
+                                    new_world=new_world,
+                                    direction=direction)
                  if self._tracer is not None else None)
 
         def _span(name):
@@ -251,7 +378,8 @@ class ElasticController:
             detected_at=failed_at, resume_step=resume_step,
             dispatch=dispatch, old_world=old_world, new_world=new_world,
             lost=lost, path=path, seconds=time.perf_counter() - t0,
-            steps_replayed=failed_at - resume_step)
+            steps_replayed=failed_at - resume_step,
+            direction=direction, returned=returned)
         self.records.append(rec)
         if self._stats is not None:
             self._stats.remeshes += 1
@@ -259,9 +387,10 @@ class ElasticController:
             self._telemetry.events.remesh(
                 old_world=old_world, new_world=new_world, lost=lost,
                 path=path, it=resume_step, detected_at=failed_at,
-                seconds=rec.seconds, steps_replayed=rec.steps_replayed)
-        self._log(f"re-mesh complete in {rec.seconds:.3f}s via {path}: "
-                  f"resuming at step {resume_step} "
+                seconds=rec.seconds, steps_replayed=rec.steps_replayed,
+                direction=direction, returned=returned)
+        self._log(f"re-mesh ({direction}) complete in {rec.seconds:.3f}s "
+                  f"via {path}: resuming at step {resume_step} "
                   f"({rec.steps_replayed} steps to re-train)")
         return Resume(new_mesh, new_world, state, step_fn, window_shard,
                       batches, resume_step, rec)

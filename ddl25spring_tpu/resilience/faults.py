@@ -15,7 +15,11 @@ It can, at chosen steps/rounds:
 - kill data-parallel replicas (``device_loss``: the wrapped step raises
   ``ReplicaLossError`` instead of dispatching, modeling the dispatch dying
   with the device — resilience/elastic.py turns it into a re-mesh onto the
-  survivors).
+  survivors);
+- return previously-lost replicas (``device_return``: the wrapped step
+  raises ``ReplicaReturnSignal`` instead of dispatching, modeling the
+  cluster scheduler handing capacity back at a dispatch boundary —
+  resilience/elastic.py turns it into a scale-UP re-mesh).
 
 Plans parse from a compact spec string so bench.py / experiments can take
 them straight off a CLI flag or config field::
@@ -31,6 +35,8 @@ them straight off a CLI flag or config field::
     "delay_client@1:1"            1 client straggles past deadline, round 1
     "device_loss@4"               1 DP replica dies at dispatch 4
     "device_loss@4:2"             2 DP replicas die at dispatch 4
+    "device_return@6"             1 lost replica comes back at dispatch 6
+    "device_return@6:2"           2 lost replicas come back at dispatch 6
     "nan_grad@10,preempt@25"      comma-composed
 
 Determinism contract: the same (spec, seed) always injects the same faults
@@ -50,7 +56,14 @@ import numpy as np
 GRAD_FAULTS = ("nan_grad", "inf_grad", "spike_grad")
 CLIENT_FAULTS = ("drop_client", "delay_client")
 KINDS = GRAD_FAULTS + CLIENT_FAULTS + ("preempt", "corrupt_ckpt",
-                                       "device_loss")
+                                       "device_loss", "device_return")
+
+# Seed-stream salt for ReplicaLossError.victims — frozen at the KINDS
+# length of the release that shipped device_loss, NOT len(KINDS): growing
+# the kind vocabulary must never re-roll which replicas a committed
+# (spec, seed) pair kills, or every pinned elastic trajectory would
+# silently change out from under its test.
+_VICTIM_SALT = 8
 
 
 class ReplicaLossError(RuntimeError):
@@ -79,8 +92,45 @@ class ReplicaLossError(RuntimeError):
     def victims(self, n: int) -> List[int]:
         k = min(self.count, n - 1)
         rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, self.step, len(KINDS)]))
+            np.random.SeedSequence([self.seed, self.step, _VICTIM_SALT]))
         return sorted(int(i) for i in rng.choice(n, size=k, replace=False))
+
+
+class ReplicaReturnSignal(RuntimeError):
+    """Previously-lost data-parallel capacity came back at dispatch ``step``.
+
+    The scale-UP twin of ``ReplicaLossError``: raised by
+    ``FaultPlan.wrap_step`` in place of running the scheduled dispatch, so
+    the grow lands exactly at a dispatch boundary with the incoming state
+    buffers untouched (donation never happened) — replay-safe under the
+    same ``start=`` counter contract as ``device_loss``. With an
+    ``ElasticController`` attached it becomes a grow re-mesh
+    (resilience/elastic.py); without one it propagates and kills the run —
+    a non-elastic run has no use for returned capacity, and silently
+    ignoring a scheduled event would make chaos specs lie.
+
+    ``arrivals(lost)`` picks WHICH of the currently-lost replica slots
+    come back — a seeded deterministic choice over the lost pool (same
+    (seed, step, pool) → same arrivals), capped at the pool size. A
+    distinct salt keeps the arrival stream independent of the victim
+    stream even at a shared (seed, step)."""
+
+    def __init__(self, step: int, count: int = 1, seed: int = 0):
+        super().__init__(f"replica return at dispatch {step} "
+                         f"({count} replica{'s' if count != 1 else ''})")
+        self.step = int(step)
+        self.count = max(1, int(count))
+        self.seed = int(seed)
+
+    def arrivals(self, lost: List[int]) -> List[int]:
+        pool = sorted(int(i) for i in lost)
+        k = min(self.count, len(pool))
+        if k == 0:
+            return []
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.step, _VICTIM_SALT + 1]))
+        picked = rng.choice(len(pool), size=k, replace=False)
+        return sorted(pool[int(i)] for i in picked)
 
 
 @dataclass(frozen=True)
@@ -175,6 +225,9 @@ class FaultPlan:
     def device_loss_at(self, step: int) -> Optional[FaultEvent]:
         return self._at(("device_loss",), step)
 
+    def device_return_at(self, step: int) -> Optional[FaultEvent]:
+        return self._at(("device_return",), step)
+
     def wrap_step(self, step_fn, stats=None, *, start: int = 0):
         """Wrap ``step_fn(state, batch) -> (state, loss)`` so grad faults,
         simulated preemptions and replica losses fire at their scheduled
@@ -187,7 +240,9 @@ class FaultPlan:
         ``device_loss`` raises ``ReplicaLossError`` BEFORE the step runs —
         the dispatch dies with the device, the incoming state buffers are
         untouched (donation never happened), and the elastic layer decides
-        what survives. Gradient faults poison the *outputs* exactly as the
+        what survives. ``device_return`` raises ``ReplicaReturnSignal``
+        before the step runs the same way, so a grow re-mesh lands at the
+        identical dispatch boundary a loss would. Gradient faults poison the *outputs* exactly as the
         corrupted gradient would have: ``nan_grad``/``inf_grad`` make every
         updated param and the loss NaN/Inf (any standard optimizer update
         propagates a non-finite gradient into every touched coordinate);
@@ -222,6 +277,11 @@ class FaultPlan:
             if dl is not None:
                 raise ReplicaLossError(step, int(dl.arg) if dl.arg else 1,
                                        seed=self.seed)
+            dr = self.device_return_at(step)
+            if dr is not None:
+                raise ReplicaReturnSignal(step,
+                                          int(dr.arg) if dr.arg else 1,
+                                          seed=self.seed)
             if self.preempt_at(step):
                 os.kill(os.getpid(), signal.SIGTERM)
             e = self.grad_fault_at(step)

@@ -33,6 +33,13 @@ streams bitwise ``generate()``'s) — and fronts them with:
   provenance (watching the trainer's checkpoint stream) lives in
   serving/deploy.py; this module only applies an already-loaded tree.
 
+- **Active capacity** — ``set_active(k)`` restricts NEW routes to engines
+  ``[0, k)`` while deactivated engines drain their outstanding work to
+  completion. This is the serving half of the elasticity control plane:
+  resilience/autoscale.py moves capacity between the training mesh and
+  this fleet by pairing ``set_active`` with the trainer's elastic
+  ``resize`` at a chunk edge (experiments/autoscale_smoke.py).
+
 Telemetry (schema v6): one ``route`` event per dispatch decision, one
 ``deploy`` event + span per engine swap, and every ``request_*`` event
 tagged with its ``engine`` — ``experiments/obs_report.py`` groups the
@@ -104,20 +111,27 @@ class Router:
         return percentile(vals, 50) * (
             1.0 + sched.outstanding / max(1, sched.engine.num_slots))
 
-    def pick(self, req: Request, now: float) -> int:
-        """Choose the engine for ``req`` and emit the ``route`` event."""
+    def pick(self, req: Request, now: float,
+             eligible: Optional[Sequence[int]] = None) -> int:
+        """Choose the engine for ``req`` and emit the ``route`` event.
+        ``eligible`` restricts the choice (the fleet's active-capacity
+        seam: a drained-but-not-yet-reactivated engine must not receive
+        new work); default is every engine."""
         self.harvest(now)
+        ids = (list(eligible) if eligible is not None
+               else list(range(len(self.scheds))))
+        if not ids:
+            raise ValueError("Router.pick: no eligible engines")
         loads = [s.outstanding for s in self.scheds]
         if self.policy == "least_loaded":
-            eid = min(range(len(self.scheds)), key=lambda i: (loads[i], i))
+            eid = min(ids, key=lambda i: (loads[i], i))
             predicted = None
         else:
-            predictions = [self.predicted_ttft(i)
-                           for i in range(len(self.scheds))]
+            predictions = {i: self.predicted_ttft(i) for i in ids}
             # No samples yet anywhere → identical (None) predictions:
             # the load/id tie-break below IS least-loaded, so a cold
             # fleet still spreads deterministically.
-            eid = min(range(len(self.scheds)),
+            eid = min(ids,
                       key=lambda i: (predictions[i]
                                      if predictions[i] is not None else 0.0,
                                      loads[i], i))
@@ -179,12 +193,36 @@ class ServingFleet:
                              events=events)
         self.engine_of: Dict[str, int] = {}     # rid -> routed engine
         self._swap = None       # pending publish: rolls out one engine/tick
+        self._active = num_engines  # engines [0, _active) accept new work
         self.deploys: List[dict] = []
+
+    # ------------------------------------------------------------- capacity
+    @property
+    def active_engines(self) -> int:
+        """How many engines currently accept NEW requests."""
+        return self._active
+
+    def set_active(self, k: int) -> None:
+        """Serve new requests on engines ``[0, k)`` only — the autoscaler's
+        capacity seam (resilience/autoscale.py). Shrinking DRAINS rather
+        than drops: a deactivated engine stops receiving routes immediately
+        but ``tick()`` keeps advancing any engine with outstanding work, so
+        its queued and in-flight streams finish on the engine they started
+        on (per-slot state cannot migrate) — same chunk-edge discipline as
+        the trainer's elastic drain. Growing is instant: a reactivated
+        engine holds no state a request could miss (weights roll out to
+        every engine regardless of active status, see ``publish``)."""
+        k = int(k)
+        if not 1 <= k <= len(self.engines):
+            raise ValueError(f"set_active({k}): fleet has "
+                             f"{len(self.engines)} engines; need 1 <= k <= "
+                             f"{len(self.engines)}")
+        self._active = k
 
     # ------------------------------------------------------------- dispatch
     def submit(self, req: Request, now: Optional[float] = None) -> int:
         now = self.clock() if now is None else now
-        eid = self.router.pick(req, now)
+        eid = self.router.pick(req, now, eligible=range(self._active))
         self.scheds[eid].submit(req, now=now)
         self.engine_of[req.rid] = eid
         return eid

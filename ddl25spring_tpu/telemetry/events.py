@@ -61,10 +61,14 @@ from typing import Any, Dict, Iterator, List, Optional
 # counts and tokens emitted by the ONE verify dispatch — the
 # acceptance-rate and tokens-per-dispatch accounting obs_report renders
 # and slo_monitor's acceptance floor watches).
-# Version bumps are additive: a v7 reader accepts v1–v6 streams
-# unchanged, and older readers reject v7 (the "future schema" rule in
+# v8: autoscaling (resilience/autoscale.py) — ``scale`` (one capacity
+# move between the training mesh and the serving fleet: direction plus
+# the post-transition allocation, rendered by obs_report's "scale"
+# section and marked as a Perfetto instant by trace_export).
+# Version bumps are additive: a v8 reader accepts v1–v7 streams
+# unchanged, and older readers reject v8 (the "future schema" rule in
 # validate_event) rather than misread it.
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 # Event types this schema version defines. The type set is CLOSED per
 # schema version: ``validate_event`` checks base fields for all types, the
@@ -76,7 +80,7 @@ EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
                "request_done", "fl_cohort", "fl_tier", "span",
                "slo_violation", "numerics", "compile", "route", "deploy",
-               "speculate")
+               "speculate", "scale")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -155,6 +159,15 @@ _REQUIRED: Dict[str, tuple] = {
     # and ``engine``. acceptance = accepted/proposed; tokens-per-dispatch
     # = emitted per event (one verify dispatch each).
     "speculate": ("proposed", "accepted"),
+    # Autoscaling (resilience/autoscale.py, schema v8): one event per
+    # capacity move between training and serving — ``direction``
+    # ("train_to_serve" / "serve_to_train"), ``train_world`` /
+    # ``serve_engines`` the POST-transition allocation (the
+    # replicas-over-time series obs_report plots); extras carry the
+    # triggering ``signal`` (e.g. "ttft_pressure", "traffic_ebb"), the
+    # measured value behind it, ``it`` (the training chunk edge the move
+    # landed on) and ``seconds`` (the re-mesh cost, when training moved).
+    "scale": ("direction", "train_world", "serve_engines"),
     # Compile/retrace accounting (introspect.CompileWatch, schema v5):
     # one event per XLA compilation of a watched jit entry point —
     # ``name`` the factory label, ``seconds`` the compiling call's wall
@@ -376,6 +389,13 @@ class EventLog:
     # scheduler.py swaps).
     def route(self, *, req: str, engine: int, **fields) -> Dict[str, Any]:
         return self.emit("route", req=req, engine=engine, **fields)
+
+    # Autoscaling (schema v8; resilience/autoscale.py emits).
+    def scale(self, *, direction: str, train_world: int, serve_engines: int,
+              **fields) -> Dict[str, Any]:
+        return self.emit("scale", direction=direction,
+                         train_world=train_world,
+                         serve_engines=serve_engines, **fields)
 
     def deploy(self, *, version, **fields) -> Dict[str, Any]:
         return self.emit("deploy", version=version, **fields)

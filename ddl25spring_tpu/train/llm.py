@@ -655,13 +655,18 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                       stats: Optional[ResilienceStats] = None,
                       telemetry=None, steps_per_dispatch: int = 1,
                       window_shard_fn=None,
-                      on_checkpoint=None) -> LLMTrainReport:
+                      on_checkpoint=None, scale_hook=None) -> LLMTrainReport:
     """The chunked training loop (``_run_loop`` chunked mode) with a
     replica-loss recovery path threaded through it: every dispatch runs
-    under a ``ReplicaLossError`` catch, every chunk edge feeds the
-    controller's host-RAM mirror, and a caught loss drains the in-flight
-    work, hands the world to ``ElasticController.recover`` and swaps in
-    the survivors' mesh/state/step/stream before continuing.
+    under a ``ReplicaLossError``/``ReplicaReturnSignal`` catch, every
+    chunk edge feeds the controller's host-RAM mirror, and a caught loss
+    (or return) drains the in-flight work, hands the world to
+    ``ElasticController.recover`` (``grow``) and swaps in the new
+    mesh/state/step/stream before continuing. ``scale_hook(it, world)``
+    is additionally polled at every chunk edge; a non-None target world
+    triggers ``ElasticController.resize`` — the autoscaler's
+    capacity-change path, zero steps lost (the resize snapshots the
+    just-drained state at the edge itself).
 
     Zero-fault contract: the loss trajectory is bitwise the non-elastic
     path's — the step functions come from the same factories, the windows
@@ -681,7 +686,7 @@ def _run_elastic_loop(controller, step_fn, state, batches,
     ``tokens_per_sec`` counts each topology's tokens at its own width
     (wall time includes recovery, honestly); ``post_remesh_tokens_per_sec``
     times the final topology from its first post-recovery synced chunk."""
-    from ..resilience.faults import ReplicaLossError
+    from ..resilience.faults import ReplicaLossError, ReplicaReturnSignal
 
     report = LLMTrainReport()
     report.start_step = start_step
@@ -729,6 +734,43 @@ def _run_elastic_loop(controller, step_fn, state, batches,
 
     preempt = PreemptionHandler()
     last_it = start_step - 1
+    staged = None                   # (first step index, host window)
+    edge = start_step
+
+    def _swap(resume):
+        # Install a Resume's world — shared by the fault paths (loss /
+        # return) and the scale_hook resize. Step indices stay stream
+        # positions: the record truncates to the resume point ``m`` and
+        # every cursor rewinds with it (a fault path can land below the
+        # current edge; a resize lands exactly ON it and truncates
+        # nothing).
+        nonlocal n_data, state, step_fn, window_shard_fn, batches, \
+            last_it, last_flush_edge, last_event_t, last_event_it, \
+            phase_t0, phase_tokens, staged, edge
+        n_data = resume.n_data
+        state, step_fn = resume.state, resume.step_fn
+        window_shard_fn, batches = resume.window_shard_fn, resume.batches
+        m = resume.step
+        pending[:] = [p for p in pending if p[0] < m]
+        # The loss record indexes from report.start_step; a slow-path
+        # rewind can land BELOW it (digest-failed newest step → older
+        # checkpoint), in which case the record now begins at m and
+        # start_step must follow or every consumer (hw1b's sink rows,
+        # report.steps) mislabels by the gap.
+        del report.losses[max(0, m - report.start_step):]
+        report.start_step = min(report.start_step, m)
+        report.remeshes.append(resume.record.as_dict())
+        # Rewind the progress cursor too: steps in [m, detected_at) were
+        # discarded with the old topology, and a preemption landing
+        # before they are re-trained must report/force-save position m,
+        # not the rolled-back high-water mark.
+        last_it = m - 1
+        last_flush_edge = min(last_flush_edge, m)
+        last_event_t = time.perf_counter()
+        last_event_it = m - 1
+        phase_t0, phase_tokens = None, 0.0
+        staged = None               # old width, old stream
+        edge = m
 
     def _force_save(at: int) -> None:
         if ckpt is not None:
@@ -775,42 +817,22 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                 with _phase("dispatch", droot, "compute"):
                     state, losses = step_fn(state,
                                             window_shard_fn(window))
-            except ReplicaLossError as err:
+            except (ReplicaLossError, ReplicaReturnSignal) as err:
+                grow = isinstance(err, ReplicaReturnSignal)
                 if droot is not None:
-                    droot.end(replica_loss=True)
+                    droot.end(**{"replica_return" if grow
+                                 else "replica_loss": True})
                 with spans("recover"):
                     # Drain: settle in-flight work AND keep the host
-                    # copies — the device arrays belong to the dead
+                    # copies — the device arrays belong to the old
                     # topology, and a flush after recovery must not
                     # re-read buffers a real backend failure took away.
                     pending[:] = [(i0, np.asarray(ls))
                                   for i0, ls in pending]
-                    resume = controller.recover(err, failed_at=it0,
-                                                dispatch=this_dispatch)
-                n_data = resume.n_data
-                state, step_fn = resume.state, resume.step_fn
-                window_shard_fn, batches = resume.window_shard_fn, \
-                    resume.batches
-                m = resume.step
-                pending[:] = [p for p in pending if p[0] < m]
-                # The loss record indexes from report.start_step; a slow-
-                # path rewind can land BELOW it (digest-failed newest step
-                # → older checkpoint), in which case the record now begins
-                # at m and start_step must follow or every consumer
-                # (hw1b's sink rows, report.steps) mislabels by the gap.
-                del report.losses[max(0, m - report.start_step):]
-                report.start_step = min(report.start_step, m)
-                report.remeshes.append(resume.record.as_dict())
-                # Rewind the progress cursor too: steps in [m, failed_at)
-                # were discarded with the dead topology, and a preemption
-                # landing before they are re-trained must report/force-save
-                # position m, not the rolled-back high-water mark.
-                last_it = m - 1
-                last_flush_edge = min(last_flush_edge, m)
-                last_event_t = time.perf_counter()
-                last_event_it = m - 1
-                phase_t0, phase_tokens = None, 0.0
-                edge = m
+                    handle = controller.grow if grow else controller.recover
+                    resume = handle(err, failed_at=it0,
+                                    dispatch=this_dispatch)
+                _swap(resume)
                 continue
             tokens_per_step = (n_data * train_cfg.batch_size
                                * train_cfg.seq_len)
@@ -882,6 +904,24 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                     log_fn(f"periodic checkpoint at {it1} failed after "
                            f"retries ({type(e).__name__}: {e}); "
                            "continuing")
+            if scale_hook is not None and it1 < train_cfg.iters:
+                # Capacity-change seam (resilience/autoscale.py): the
+                # hook sees the just-drained edge; a differing target
+                # world re-meshes HERE — state snapshotted at this exact
+                # position, so nothing is replayed and nothing is lost.
+                target = scale_hook(it1, n_data)
+                if target is not None and int(target) != n_data:
+                    with spans("recover"):
+                        pending[:] = [(i0, np.asarray(ls))
+                                      for i0, ls in pending]
+                        resume = controller.resize(
+                            int(target), state=state, at_step=it1,
+                            dispatch=dispatch_idx - 1)
+                    if resume is not None:
+                        if droot is not None:
+                            droot.end(scaled=True)
+                        _swap(resume)
+                        continue
             if droot is not None:
                 droot.end()
             edge = it1
@@ -957,7 +997,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  resilience: Optional[ResilienceConfig] = None,
                  fault_plan=None,
                  telemetry=None,
-                 on_checkpoint=None) -> LLMTrainReport:
+                 on_checkpoint=None,
+                 scale_hook=None) -> LLMTrainReport:
     """Run DP tiny-Llama training; returns losses and throughput.
 
     ``aggregation``: "gradient" (allreduce grads — intro_DP_GA), "weight"
@@ -980,8 +1021,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     chunks in the ``wire`` format — the one path where wire compression
     composes with zero1 AND steps_per_dispatch. int8 EF residuals live in
     the state tree, so checkpoints/preemption carry them exactly. Replaces
-    ``accum_steps`` (same batch axis); ``numerics_every`` and the fused
-    ``injit_guard`` compose (elastic does not yet).
+    ``accum_steps`` (same batch axis); ``numerics_every``, the fused
+    ``injit_guard`` and ``resilience.elastic`` all compose (elastic
+    reshards the EF residual trees across re-meshes).
 
     ``train_cfg.dcn`` = D > 1 makes the DP world HIERARCHICAL: D ICI
     islands of ``data`` replicas bridged by DCN (hier_data_mesh), with
@@ -1022,7 +1064,19 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     resilience/elastic.py), re-splits the stream and resumes; recovery
     records land in ``report.remeshes`` and the telemetry ``remesh``
     event. With zero faults the elastic loop's losses are bitwise the
-    non-elastic path's.
+    non-elastic path's. Elasticity is bidirectional: a ``device_return``
+    fault (or any ``ReplicaReturnSignal``) grows the mesh back onto
+    returned devices through the same machinery, with the same bitwise
+    bar; with ``overlap_microbatches >= 1`` the compressed-wire ring
+    driver composes too (EF residuals reshard alongside the moments).
+
+    ``scale_hook(it, world)`` (requires ``resilience.elastic=True``) is
+    the autoscaler's capacity-change seam: polled at every chunk edge
+    with the just-drained stream position and current data world; a
+    non-None return is the TARGET world, and the loop re-meshes to it via
+    ``ElasticController.resize`` — snapshot at the edge, reshard, zero
+    steps lost — before continuing (resilience/autoscale.py drives this
+    from serving-side SLO pressure).
 
     ``telemetry`` (telemetry.Telemetry) opens the run's observability
     surface: a manifest event with the step's static comm profile, per-step
@@ -1139,45 +1193,45 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                 "injit_guard is not fused into the legacy per-step "
                 "compressed paths — overlap_microbatches >= 1 is the "
                 "composing path")
+    if scale_hook is not None and not elastic:
+        raise ValueError("scale_hook requires resilience.elastic=True — "
+                         "capacity changes ride the elastic re-mesh "
+                         "machinery")
     if elastic:
         # Elastic DP (resilience/elastic.py): the loop drives the [K, B, T]
         # window step (K = steps_per_dispatch, 1 included) so replica-loss
         # drain/recovery quantizes to chunk edges. Gradient/zero1 only —
-        # the weight-aggregation and compressed-wire steps own collective
-        # schedules nobody has taught to re-mesh.
+        # the weight-aggregation step owns a collective schedule nobody
+        # has taught to re-mesh. Compressed wire composes through the
+        # overlap/ring driver: its EF residual trees reshard N→M with the
+        # ZeRO-1 moments (parallel/dp.py reshard_state's ring-residual
+        # pre-pass), so elastic × int8_ef is a supported pairing.
         if aggregation not in ("gradient", "zero1"):
             raise ValueError("elastic mode supports gradient and zero1 "
                              f"aggregation only (got {aggregation!r})")
-        if train_cfg.wire != "fp32":
+        if train_cfg.wire != "fp32" and ovl == 0:
             raise ValueError(
-                f"elastic=True does not compose with wire="
-                f"{train_cfg.wire!r}: the compressed-wire drivers carry "
-                "per-shard error-feedback residual trees whose width is "
-                "the OLD world size, and nothing reshards them N→M on a "
-                "remesh the way the ZeRO-1 moments are "
-                "(ops/adam.py resize_zero_padded) — resuming them at the "
-                "survivors' width would silently mis-compensate "
-                "quantization error (ROADMAP item 7). Use wire='fp32' "
-                "with elastic, or drop elastic for the compressed path")
-        if ovl:
-            raise ValueError(
-                f"elastic=True does not compose with overlap_microbatches="
-                f"{ovl} (the ring/overlap driver): its EF residual trees "
-                "(OverlapEFState.ring_residual/gather_residual) are laid "
-                "out per (shard, ring chunk) at the OLD world size, and "
-                "no remesh path reshards them N→M like the ZeRO-1 "
-                "moments — recovery would resume with stale/mis-shaped "
-                "error feedback (ROADMAP item 7). Set "
-                "overlap_microbatches=0 with elastic, or drop elastic")
+                f"elastic=True composes with wire={train_cfg.wire!r} only "
+                "through the overlap/ring driver, whose EF residual trees "
+                "(OverlapEFState.ring_residual/gather_residual) the remesh "
+                "path reshards N→M alongside the ZeRO-1 moments — the "
+                "legacy per-step compressed paths own collective schedules "
+                "nobody re-meshes. Set overlap_microbatches >= 1, or use "
+                "wire='fp32'")
         if any(s > 1 for a, s in mesh.shape.items() if a != "data"):
             raise ValueError("elastic mode supports data-axis-only meshes "
                              f"(got {dict(mesh.shape)})")
 
         def _build_elastic(m):
             """(template_state, raw window step, window shard fn) on an
-            arbitrary data mesh — initial build AND post-loss rebuild go
+            arbitrary data mesh — initial build AND post-remesh rebuild go
             through here, so the two cannot drift."""
-            if aggregation == "zero1":
+            if ovl >= 1:
+                from ..parallel import compress
+                st, fn = compress.make_overlap_multi_step(
+                    loss_fn, optimizer, m, params, microbatches=ovl,
+                    wire=train_cfg.wire, aggregation=aggregation)
+            elif aggregation == "zero1":
                 st, fn = dp.make_zero1_multi_step(loss_fn, optimizer, m,
                                                   params)
             else:
@@ -1189,8 +1243,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             # world-size-tagged — no retrace budget (tail chunks + remesh
             # recompiles are legitimate).
             fn = introspect.watch(
-                fn, name=f"train/dp-{aggregation}-elastic-w"
-                         f"{m.shape['data']}",
+                fn, name=f"train/dp-{aggregation}-elastic"
+                         + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+                         + f"-w{m.shape['data']}",
                 max_caches=None,
                 events=(telemetry.events if telemetry is not None
                         else None),
@@ -1218,7 +1273,9 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         wire_arg = ({"ici": train_cfg.wire,
                      "dcn": train_cfg.wire_dcn or "fp32"}
                     if hier else train_cfg.wire)
-        if spd > 1:
+        if elastic:
+            state, step_fn, window_shard = _build_elastic(mesh)
+        elif spd > 1:
             state, step_fn = compress.make_overlap_multi_step(
                 loss_fn, optimizer, mesh, params, microbatches=ovl,
                 wire=wire_arg, aggregation=aggregation,
@@ -1363,7 +1420,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             sink_every=sink_every, log_every=log_every, log_fn=log_fn,
             warmup_steps_excluded=warmup_steps_excluded, stats=stats,
             telemetry=telemetry, steps_per_dispatch=spd,
-            window_shard_fn=window_shard, on_checkpoint=on_checkpoint)
+            window_shard_fn=window_shard, on_checkpoint=on_checkpoint,
+            scale_hook=scale_hook)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     batches = _make_batches(n_data)

@@ -1,0 +1,273 @@
+"""Autoscale smoke: one diurnal traffic curve drives train⇄serve moves.
+
+The CI-sized proof (tier1.yml) of the unified elasticity control plane
+(ISSUE 16): in a SINGLE process, an elastic ZeRO-1 training run and a
+two-engine serving fleet share one device pool while an ``Autoscaler``
+(resilience/autoscale.py) watches the fleet router's rolling TTFT
+windows. A seeded diurnal arrival curve peaks, p95 TTFT climbs past the
+pressure line (0.8×SLO, BELOW the violation threshold), and the policy
+drains training at a chunk edge, shrinks the mesh, and activates the
+second engine; when traffic ebbs the move reverses. The trainer applies
+each decision through ``scale_hook`` → ``ElasticController.resize`` —
+the same bidirectional re-mesh machinery the fault path uses, with the
+just-drained state pinned as the mirror so a planned move replays
+nothing.
+
+The script CHECKS the acceptance bars rather than asserting it ran:
+
+- **zero SLO violations** — the serving clock is a deterministic tick
+  counter (TTFT = queueing ticks × dt, machine-independent), and
+  ``slo_monitor --check`` replays the stream against the same TTFT SLO
+  the policy protected: capacity must have arrived BEFORE any rolling
+  p99 breach, not after;
+- **zero lost steps** — every training iteration's loss is present and
+  finite, and every scale re-mesh records ``steps_replayed == 0``
+  (resize-at-chunk-edge pins the mirror at the edge by construction);
+- **zero retraces per world size** — each world size's training watch
+  compiles fresh programs, never retraces, and every fleet engine keeps
+  its zero-retrace contract through the capacity changes;
+- the curve genuinely drives BOTH directions (≥1 train→serve and ≥1
+  serve→train move), and each ``scale`` event (schema v8) validates.
+
+Recovery costs land as bench rows (``remesh_seconds_scale``,
+``steps_replayed_scale`` — lower is better, experiments/bench_compare.py)
+in the JSON artifact; the telemetry stream (with its ``scale`` + six
+``remesh``-adjacent event kinds) is written next to it for obs_report /
+trace_export.
+
+    python -m experiments.autoscale_smoke --out autoscale-smoke.json \\
+        --telemetry-dir autoscale-telemetry
+
+Exit code 0 only when every bar holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+class _TickClock:
+    """Deterministic serving clock: time is a tick count × dt, advanced
+    only by the control loop. TTFT measured against it counts QUEUEING
+    ticks, not wall seconds, so the pressure signal (and therefore the
+    whole scale trajectory) is identical on any machine."""
+
+    def __init__(self, dt: float):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self) -> None:
+        self.t += self.dt
+
+
+def run(out_path: str, telemetry_dir: str = None, iters: int = 24,
+        slo_s: float = 1.2) -> int:
+    from ._cpu_pin import pin_cpu_virtual
+    pin_cpu_virtual()
+
+    import jax
+    import numpy as np
+
+    from ddl25spring_tpu.config import (LlamaConfig, ResilienceConfig,
+                                        TrainConfig)
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.resilience.autoscale import (Autoscaler,
+                                                      AutoscalePolicy,
+                                                      router_ttft_p95)
+    from ddl25spring_tpu.serving import PagedKVConfig, Request, ServingFleet
+    from ddl25spring_tpu.telemetry import (Telemetry, read_events,
+                                           validate_event)
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_dp
+
+    spd = 2
+    edges = iters // spd
+    # Same tiny trainer as elastic_smoke (dmodel=20: the 4-way and 3-way
+    # ZeRO-1 padded lengths differ, so every move genuinely reshards).
+    tiny = LlamaConfig(vocab_size=259, dmodel=20, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    serve_cfg = LlamaConfig(vocab_size=97, dmodel=32, num_heads=4,
+                            n_layers=2, ctx_size=32)
+    paged = PagedKVConfig(num_blocks=24, block_len=4, max_blocks_per_seq=8)
+
+    telemetry = Telemetry(telemetry_dir) if telemetry_dir else None
+    events = telemetry.events if telemetry else None
+
+    clock = _TickClock(dt=0.05)
+    # window_s spans ~2 control ticks of synthetic time (edge gap 1.0s):
+    # the pressure signal follows the CURRENT load, and an ebb actually
+    # empties the windows instead of serving stale peak samples forever.
+    fleet = ServingFleet(llama.init_llama(jax.random.PRNGKey(0), serve_cfg),
+                         serve_cfg, paged, num_engines=2, num_slots=2,
+                         prefill_chunk=4, events=events, token_events=False,
+                         clock=clock, window_s=2.0)
+    fleet.set_active(1)                      # serving starts minimal
+
+    policy = AutoscalePolicy(ttft_slo_s=slo_s, pressure_frac=0.8,
+                             ebb_frac=0.3, sustain=2, cooldown=2,
+                             min_train_world=3, max_train_world=4,
+                             min_serve_engines=1, max_serve_engines=2)
+    scaler = Autoscaler(policy, train_world=4, serve_engines=1,
+                        events=events)
+
+    # Seeded diurnal curve: arrivals per control tick follow one day of
+    # sinusoidal load across the run's chunk edges — a morning peak that
+    # overwhelms one engine, an evening ebb that idles two. The trainer
+    # fires the hook at every INTERIOR chunk edge (it < iters), so there
+    # are edges-1 control ticks.
+    ticks = edges - 1
+    rng = np.random.default_rng(7)
+    curve = [max(0, round(4.0 + 4.0 * math.sin(2 * math.pi * i / ticks)))
+             for i in range(ticks)]
+    prompts = [tuple(int(t) for t in rng.integers(1, 97, size=6))
+               for _ in range(sum(curve))]
+
+    p95_trace, rid_iter = [], iter(range(len(prompts)))
+
+    def control_tick(it, train_world):
+        """One control-plane step, run at each training chunk edge:
+        advance synthetic time to this edge, inject the tick's arrivals,
+        serve them to completion on the ACTIVE engines (inactive ones
+        only drain), read the router's rolling TTFT windows, and let the
+        policy decide."""
+        clock.t += 1.0                       # inter-edge gap: windows age
+        edge = it // spd - 1
+        for _ in range(curve[edge] if 0 <= edge < ticks else 0):
+            rid = next(rid_iter)
+            fleet.submit(Request(rid=f"r{rid}", prompt=prompts[rid],
+                                 max_new=6), now=clock())
+        while fleet.outstanding:
+            fleet.tick()
+            clock.advance()
+        fleet.router.harvest(clock())
+        p95 = router_ttft_p95(fleet.router)
+        p95_trace.append(None if p95 is None else round(p95, 4))
+        decision = scaler.tick(p95, it=it)
+        if decision is None:
+            return None
+        fleet.set_active(decision.serve_engines)
+        return decision.train_world
+
+    report = train_llm_dp(
+        tiny,
+        TrainConfig(batch_size=2, seq_len=16, lr=3e-3, iters=iters,
+                    data=4, steps_per_dispatch=spd),
+        mesh=make_mesh({"data": 4}, devices=jax.devices()[:4]),
+        tokenizer=ByteTokenizer(), aggregation="zero1", log_every=0,
+        resilience=ResilienceConfig(elastic=True, mirror_every=1),
+        telemetry=telemetry, scale_hook=control_tick)
+
+    directions = [d.direction for d in scaler.decisions]
+    scale_records = report.remeshes
+    checks = {
+        "both_directions_driven": ("train_to_serve" in directions
+                                   and "serve_to_train" in directions),
+        "every_decision_applied": (
+            bool(scale_records)
+            and len(scale_records) == len(scaler.decisions)
+            and scale_records[-1]["new_world"] == scaler.train_world
+            and [r["direction"] == ("shrink" if d.direction ==
+                                    "train_to_serve" else "grow")
+                 for r, d in zip(scale_records, scaler.decisions)]
+            == [True] * len(scale_records)),
+        # Zero lost steps: every iteration's loss exists and is finite,
+        # and no planned move replayed anything.
+        "zero_lost_steps": (len(report.losses) == iters
+                            and bool(np.isfinite(report.losses).all())
+                            and all(r["steps_replayed"] == 0
+                                    for r in scale_records)),
+        "fleet_zero_retraces": all(r == 0 for r in fleet.retraces()),
+        "all_requests_served": all(
+            len(rec.tokens) == rec.max_new
+            for rec in fleet.records.values()) and
+            len(fleet.records) == sum(curve),
+    }
+
+    per_world_compiles, slo = {}, {}
+    if telemetry is not None:
+        telemetry.close()
+        stream = read_events(telemetry.events_path)
+        scale_events = [e for e in stream if e.get("type") == "scale"]
+        checks["scale_events_valid"] = (
+            len(scale_events) == len(scaler.decisions)
+            and all(validate_event(e) == [] for e in scale_events))
+        # Zero retraces PER WORLD SIZE: compile events are tagged with
+        # the (world-suffixed) watch name; none may be a retrace.
+        for e in stream:
+            if e.get("type") == "compile":
+                row = per_world_compiles.setdefault(
+                    e.get("name"), {"compiles": 0, "retraces": 0})
+                row["compiles"] += 1
+                row["retraces"] += int(bool(e.get("retrace")))
+        checks["train_zero_retraces_per_world"] = (
+            per_world_compiles != {} and
+            all(v["retraces"] == 0 for v in per_world_compiles.values()))
+        # The SLO the policy protected, judged by the monitor that owns
+        # the verdict: replay the stream, zero rolling-window breaches.
+        from .slo_monitor import main as slo_main
+        rc = slo_main([telemetry_dir, "--check",
+                       "--ttft-p99", str(slo_s), "--no-emit"])
+        violations = [e for e in read_events(telemetry.events_path)
+                      if e.get("type") == "slo_violation"]
+        checks["zero_slo_violations"] = rc == 0 and violations == []
+        slo = {"monitor_rc": rc, "violation_events": len(violations)}
+
+    scale_seconds = [r["seconds"] for r in scale_records]
+    result = {
+        "ok": all(checks.values()),
+        "iters": iters,
+        "ttft_slo_s": slo_s,
+        "curve": curve,
+        "p95_trace": p95_trace,
+        "decisions": [d._asdict() for d in scaler.decisions],
+        "scale_remeshes": scale_records,
+        "per_world_compiles": per_world_compiles,
+        "slo": slo,
+        "requests_served": len(fleet.records),
+        "checks": checks,
+        # Recovery-cost rows for the perf trajectory (bench_compare
+        # treats both prefixes as lower-is-better).
+        "rows": [
+            {"metric": "remesh_seconds_scale",
+             "value": max(scale_seconds) if scale_seconds else 0.0,
+             "platform": "cpu", "variant": "autoscale-smoke"},
+            {"metric": "steps_replayed_scale",
+             "value": float(sum(r["steps_replayed"]
+                                for r in scale_records)),
+             "platform": "cpu", "variant": "autoscale-smoke"},
+        ],
+    }
+
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    if not result["ok"]:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"autoscale smoke FAILED checks: {failed}", file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="autoscale-smoke.json",
+                    help="acceptance-evidence JSON path")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="write the shared train+serve events.jsonl here "
+                         "(render with python -m experiments.obs_report)")
+    ap.add_argument("--iters", type=int, default=24)
+    ap.add_argument("--ttft-slo", type=float, default=1.2,
+                    help="serving TTFT SLO in (deterministic tick) "
+                         "seconds — the policy scales at 0.8x this line")
+    a = ap.parse_args(argv)
+    return run(a.out, a.telemetry_dir, a.iters, a.ttft_slo)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

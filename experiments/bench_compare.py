@@ -53,7 +53,11 @@ DERIVED_FIELDS = ("mfu", "attainment")
 # through as an "improvement". A metric whose name starts with one of
 # these prefixes is compared against the best (LOWEST) committed row and
 # gates when the candidate rises above it by more than the budget.
-LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes")
+# ``remesh_seconds`` / ``steps_replayed`` are the elasticity smokes'
+# recovery-cost rows (elastic_smoke / autoscale_smoke): slower re-mesh or
+# more re-trained steps is the regression.
+LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes",
+                            "remesh_seconds", "steps_replayed")
 
 
 def lower_is_better(metric: str) -> bool:

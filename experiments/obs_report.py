@@ -428,6 +428,30 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                   + (f"  {e['steps_replayed']} steps replayed"
                      if e.get("steps_replayed") is not None else ""))
 
+    scales = by_type.get("scale", [])
+    if scales:
+        # Autoscaler section (schema v8 ``scale`` events,
+        # resilience/autoscale.py): the control plane's decision stream.
+        # Each event carries the POST-transition allocation; the re-mesh
+        # that applied it is the ``remesh`` event whose detection step is
+        # the decision's iteration, which is where the transition's cost
+        # (seconds) lives.
+        _section("scale (autoscaler)")
+        by_detect = {e.get("detected_at"): e for e in remeshes}
+        for e in scales:
+            applied = by_detect.get(e.get("it"))
+            print(f"  it {e.get('it', '?'):>6}: "
+                  f"{e.get('direction', '?'):14s} -> "
+                  f"train {e.get('train_world', '?')} / "
+                  f"serve {e.get('serve_engines', '?')} engines  "
+                  f"({e.get('signal', '?')} {_fmt_num(e.get('value'))})"
+                  + (f"  applied in {applied['seconds']:.3f}s"
+                     if applied and isinstance(applied.get("seconds"),
+                                               (int, float)) else ""))
+        allocs = [f"{e.get('train_world', '?')}t/"
+                  f"{e.get('serve_engines', '?')}s" for e in scales]
+        print(f"  allocation over time: ... -> " + " -> ".join(allocs))
+
     if rounds:
         _section("fl rounds")
         accs = [r["test_accuracy"] for r in rounds

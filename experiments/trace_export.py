@@ -45,7 +45,7 @@ from ddl25spring_tpu.telemetry.events import read_events
 # Flat events rendered as instant markers on the timeline (sparse,
 # diagnostic). Everything else flat is either covered by a span
 # (request_*, step) or not a point in time (manifest, run_end metrics).
-INSTANT_TYPES = ("fault", "remesh", "slo_violation")
+INSTANT_TYPES = ("fault", "remesh", "slo_violation", "scale")
 
 # Span fields that are structure, not attributes.
 _SPAN_BASE = ("schema", "run_id", "seq", "t", "type", "name", "trace_id",

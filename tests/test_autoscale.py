@@ -1,0 +1,177 @@
+"""SLO-driven autoscaler policy loop + fleet capacity seam (ISSUE 16).
+
+The policy half of the elasticity control plane is mechanism-free and
+jax-free, so these tests feed it synthetic TTFT sequences and assert the
+DECISION stream: scale-out fires on sustained pressure BELOW the SLO
+(capacity arrives before a violation, not after), scale-in on sustained
+ebb, cooldown stops flapping, and bounds are hard walls. The serving
+side's seam — routing restricted to the active engine set while drained
+engines finish outstanding work — is pinned at the Router level with
+stub schedulers (the full trainer×fleet wiring runs in
+experiments/autoscale_smoke.py, CI-gated)."""
+
+from collections import deque
+
+import pytest
+
+from ddl25spring_tpu.resilience.autoscale import (Autoscaler,
+                                                  AutoscalePolicy,
+                                                  ScaleDecision,
+                                                  router_ttft_p95)
+from ddl25spring_tpu.serving.fleet import Router
+from ddl25spring_tpu.telemetry.events import (EventLog, read_events,
+                                              validate_event)
+
+
+def _policy(**kw):
+    base = dict(ttft_slo_s=1.0, max_train_world=4, max_serve_engines=3,
+                sustain=2, cooldown=2)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+# ---------------------------------------------------------------- policy
+
+def test_policy_validation_refuses_nonsense():
+    with pytest.raises(ValueError):                 # reacts after violation
+        _policy(pressure_frac=1.0)
+    with pytest.raises(ValueError):                 # overlapping bands
+        _policy(ebb_frac=0.9)
+    with pytest.raises(ValueError):
+        _policy(ttft_slo_s=0.0)
+    with pytest.raises(ValueError):
+        _policy(sustain=0)
+    with pytest.raises(ValueError):
+        _policy(min_train_world=5)                  # min > max
+    with pytest.raises(ValueError):
+        AutoscalePolicy(ttft_slo_s=1.0, max_train_world=4,
+                        max_serve_engines=0)
+    with pytest.raises(ValueError):                 # start outside bounds
+        Autoscaler(_policy(), train_world=5, serve_engines=1)
+
+
+def test_scale_out_needs_sustained_pressure_below_slo():
+    """One hot tick is noise; ``sustain`` consecutive hot ticks move a
+    replica — and the trigger line is 0.8×SLO, so the decision lands
+    while requests are still inside their budget."""
+    a = Autoscaler(_policy(), train_world=4, serve_engines=1, log_fn=None)
+    assert a.tick(0.85) is None                     # streak 1: hold
+    d = a.tick(0.85)                                # streak 2: move
+    assert d == ScaleDecision("train_to_serve", 3, 2, "ttft_pressure", 0.85)
+    assert a.train_world == 3 and a.serve_engines == 2
+    # A cold measurement resets the streak.
+    b = Autoscaler(_policy(), train_world=4, serve_engines=1, log_fn=None)
+    assert b.tick(0.85) is None
+    assert b.tick(0.5) is None                      # streak broken
+    assert b.tick(0.85) is None                     # streak 1 again
+    assert b.decisions == []
+
+
+def test_scale_in_on_ebb_and_idle_reads_as_ebb():
+    """Sustained quiet (including a window with NO samples — an idle
+    fleet is over-provisioned by definition) hands capacity back."""
+    a = Autoscaler(_policy(), train_world=2, serve_engines=3, log_fn=None)
+    assert a.tick(0.1) is None
+    d = a.tick(None)                                # idle counts as ebb
+    assert d == ScaleDecision("serve_to_train", 3, 2, "traffic_ebb", 0.0)
+    assert a.train_world == 3 and a.serve_engines == 2
+
+
+def test_cooldown_blocks_flapping_but_streaks_accumulate():
+    """After a move, ``cooldown`` ticks pass with no decision even under
+    continuous pressure (the post-move window still holds pre-move
+    samples); pressure that PERSISTS through cooldown acts on the first
+    eligible tick, not ``sustain`` ticks later."""
+    a = Autoscaler(_policy(), train_world=4, serve_engines=1, log_fn=None)
+    assert a.tick(0.9) is None
+    assert a.tick(0.9) is not None                  # move 1
+    assert a.tick(0.9) is None                      # cooldown 1
+    assert a.tick(0.9) is None                      # cooldown 2
+    d = a.tick(0.9)                                 # streak sustained
+    assert d is not None and d.train_world == 2 and d.serve_engines == 3
+    assert len(a.decisions) == 2
+
+
+def test_bounds_are_hard_walls():
+    """At min_train_world no pressure drains training further; at
+    max_train_world no ebb grows it further — the loop simply holds."""
+    p = _policy(min_train_world=2, max_serve_engines=2)
+    a = Autoscaler(p, train_world=2, serve_engines=2, log_fn=None)
+    for _ in range(6):
+        assert a.tick(0.95) is None                 # pinned at the floor
+    b = Autoscaler(p, train_world=4, serve_engines=1, log_fn=None)
+    for _ in range(6):
+        assert b.tick(None) is None                 # pinned at the ceiling
+    assert a.decisions == [] and b.decisions == []
+
+
+def test_scale_event_schema_valid(tmp_path):
+    """Every decision emits one schema-v8 ``scale`` event carrying the
+    POST-transition allocation + the triggering signal, and it validates
+    clean."""
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_id="r1") as log:
+        a = Autoscaler(_policy(), train_world=4, serve_engines=1,
+                       events=log, log_fn=None)
+        a.tick(0.9, it=3)
+        a.tick(0.9, it=4)
+    events = read_events(path)
+    scale = [e for e in events if e.get("type") == "scale"]
+    assert len(scale) == 1
+    assert validate_event(scale[0]) == []
+    assert scale[0]["direction"] == "train_to_serve"
+    assert scale[0]["train_world"] == 3 and scale[0]["serve_engines"] == 2
+    assert scale[0]["signal"] == "ttft_pressure"
+    assert scale[0]["value"] == 0.9 and scale[0]["it"] == 4
+
+
+# ----------------------------------------------------------- fleet seam
+
+class _StubEngine:
+    num_slots = 4
+
+
+class _StubSched:
+    """Just enough scheduler surface for Router: a load counter and a
+    completed-request feed."""
+
+    def __init__(self, outstanding=0):
+        self.outstanding = outstanding
+        self.recent_done = deque()
+        self.engine = _StubEngine()
+
+
+class _Req:
+    def __init__(self, rid):
+        self.rid = rid
+        self.tenant = "default"
+
+
+def test_router_eligible_restricts_routing():
+    """The capacity seam: ``eligible`` confines new routes to the active
+    set even when an inactive engine is the emptier one, and an empty set
+    is a hard error."""
+    scheds = [_StubSched(outstanding=5), _StubSched(outstanding=5),
+              _StubSched(outstanding=0)]
+    r = Router(scheds)
+    assert r.pick(_Req("a"), now=0.0) == 2          # unrestricted: emptiest
+    assert r.pick(_Req("b"), now=0.0, eligible=range(2)) == 0
+    assert r.pick(_Req("c"), now=0.0, eligible=[1]) == 1
+    with pytest.raises(ValueError):
+        r.pick(_Req("d"), now=0.0, eligible=[])
+
+
+def test_router_ttft_p95_reads_the_routing_windows():
+    """The autoscaler's measurement is the router's own rolling windows:
+    None while empty, the fleet-wide p95 once harvested, and expiry
+    follows ``window_s`` exactly like routing."""
+    scheds = [_StubSched(), _StubSched()]
+    r = Router(scheds, window_s=10.0)
+    assert router_ttft_p95(r) is None
+    scheds[0].recent_done.extend([(0.0, 0.1), (1.0, 0.2)])
+    scheds[1].recent_done.append((1.5, 0.4))
+    r.harvest(2.0)
+    p95 = router_ttft_p95(r)
+    assert p95 is not None and 0.2 <= p95 <= 0.4
+    r.harvest(50.0)                                 # everything expired
+    assert router_ttft_p95(r) is None

@@ -29,8 +29,9 @@ from ddl25spring_tpu.models import llama
 from ddl25spring_tpu.ops import causal_lm_loss
 from ddl25spring_tpu.ops.adam import resize_zero_padded
 from ddl25spring_tpu.parallel import dp, make_mesh
-from ddl25spring_tpu.parallel.mesh import survivor_submesh
-from ddl25spring_tpu.resilience import FaultPlan, ReplicaLossError
+from ddl25spring_tpu.parallel.mesh import rejoin_mesh, survivor_submesh
+from ddl25spring_tpu.resilience import (FaultPlan, ReplicaLossError,
+                                        ReplicaReturnSignal)
 from ddl25spring_tpu.tokenizers import ByteTokenizer
 from ddl25spring_tpu.train.llm import train_llm_dp
 
@@ -46,14 +47,28 @@ def _mesh(devices, n):
 
 
 def _train(devices, n, *, iters=8, tmp=None, name=None, agg="zero1",
-           spd=2, resilience=None, checkpoint_every=1000):
+           spd=2, resilience=None, checkpoint_every=1000, wire="fp32",
+           ovl=0):
     return train_llm_dp(
         TINY,
-        TrainConfig(**BASE, iters=iters, data=n, steps_per_dispatch=spd),
+        TrainConfig(**BASE, iters=iters, data=n, steps_per_dispatch=spd,
+                    wire=wire, overlap_microbatches=ovl),
         mesh=_mesh(devices, n), tokenizer=ByteTokenizer(), aggregation=agg,
         log_every=0, resilience=resilience,
         checkpoint_dir=None if tmp is None else str(tmp / name),
         checkpoint_every=checkpoint_every)
+
+
+def _prune_to(tmp, src, dst, step):
+    """Copy a checkpoint dir keeping only ``step``'s save, so a fresh run
+    resumes from exactly that recovery point."""
+    shutil.copytree(tmp / src, tmp / dst)
+    for name in os.listdir(tmp / dst):
+        if name.isdigit() and int(name) != step:
+            shutil.rmtree(tmp / dst / name)
+    for name in os.listdir(tmp / dst / "digests"):
+        if int(name.partition(".")[0]) != step:
+            os.unlink(tmp / dst / "digests" / name)
 
 
 # ------------------------------------------------------------- primitives
@@ -320,28 +335,226 @@ def test_elastic_telemetry_remesh_event_and_recovery_json(tmp_path, devices):
     assert "remesh" in out and "4 -> 3" in out
 
 
-def test_elastic_refuses_compressed_wire_and_ring_driver(devices):
-    """Satellite pin (ISSUE 14 / ROADMAP item 7): elastic=True composed
-    with the compressed-wire or ring/overlap drivers must hard-error AT
-    CONFIG TIME with a message naming the exact combination and the
-    EF-residual-reshard reason — the residual trees are laid out at the
-    OLD world size and no remesh path reshards them N→M like the ZeRO-1
-    moments, so letting the run start would be a silent wrong-answer
-    path after the first recovery, not a crash."""
+def test_elastic_compressed_wire_needs_ring_driver(devices):
+    """Repinned composition rule (ISSUE 16, was ISSUE 14's blanket
+    refusal): elastic + compressed wire is now SUPPORTED — but only
+    through the overlap/ring driver, whose ``OverlapEFState`` residual
+    trees the remesh path reshards N→M alongside the ZeRO-1 moments
+    (parallel/dp.py:_resize_ring_residual). A compressed wire WITHOUT the
+    ring driver still hard-errors at config time (the legacy per-step
+    compressed paths own collective schedules nobody re-meshes), and the
+    message must name the knob value plus the fix so it is actionable
+    from the traceback alone."""
     kw = dict(mesh=_mesh(devices, 2), tokenizer=ByteTokenizer(),
               log_every=0,
               resilience=ResilienceConfig(elastic=True))
-    with pytest.raises(ValueError, match="error-feedback residual"):
-        train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
-                                       wire="int8_ef"), **kw)
-    with pytest.raises(ValueError, match="ring/overlap driver"):
-        train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
-                                       overlap_microbatches=1), **kw)
-    # Both messages must name the unsupported knob's value so the fix is
-    # actionable from the traceback alone.
     with pytest.raises(ValueError, match="wire='int8_ef'"):
         train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
                                        wire="int8_ef"), **kw)
-    with pytest.raises(ValueError, match="overlap_microbatches=2"):
+    with pytest.raises(ValueError, match="overlap_microbatches >= 1"):
         train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
-                                       overlap_microbatches=2), **kw)
+                                       wire="int8_ef"), **kw)
+    # The supported composition runs: elastic + int8 EF wire + ring
+    # driver, no faults — two clean steps, finite losses.
+    got = train_llm_dp(TINY, TrainConfig(**BASE, iters=2, data=2,
+                                         wire="int8_ef",
+                                         overlap_microbatches=1), **kw)
+    assert len(got.losses) == 2
+    assert all(np.isfinite(l) for l in got.losses)
+
+# ------------------------------------------------------ scale-up (ISSUE 16)
+
+def test_rejoin_mesh_restores_pool_order(devices):
+    """The scale-UP inverse of survivor_submesh: rejoining the lost
+    device with the original pool reconstructs the ORIGINAL device order
+    (what makes 4→3→4 comparable to a fresh 4-replica run), duplicates
+    and out-of-pool devices are hard errors, and the DP-only scope
+    matches the shrink primitive."""
+    pool = devices[:4]
+    mesh4 = _mesh(devices, 4)
+    sub = survivor_submesh(mesh4, [1])
+    back = rejoin_mesh(sub, [devices[1]], pool=pool)
+    assert list(back.devices.flatten()) == list(pool)   # original order
+    # Without the pool, returned devices append at the end.
+    tail = rejoin_mesh(sub, [devices[1]])
+    assert list(tail.devices.flatten()) == [devices[0], devices[2],
+                                            devices[3], devices[1]]
+    with pytest.raises(ValueError):                     # already present
+        rejoin_mesh(sub, [devices[0]], pool=pool)
+    with pytest.raises(ValueError):                     # duplicate arrivals
+        rejoin_mesh(sub, [devices[1], devices[1]], pool=pool)
+    with pytest.raises(ValueError):                     # outside the pool
+        rejoin_mesh(sub, [devices[7]], pool=pool)
+    with pytest.raises(ValueError):                     # nothing returned
+        rejoin_mesh(sub, [], pool=pool)
+    pp_mesh = make_mesh({"data": 2, "stage": 2}, devices=devices[:4])
+    with pytest.raises(ValueError):                     # DP-only scope
+        rejoin_mesh(pp_mesh, [devices[4]])
+
+
+def test_device_return_parse_arrivals_deterministic():
+    """``device_return`` faults parse like ``device_loss``, raise BEFORE
+    the dispatch runs, replay-safely skip with ``start=``, and pick
+    seeded-deterministic arrivals from the absent pool — while the
+    device_loss victim choice is pinned against vocabulary growth (adding
+    the new kind must not re-roll committed victims)."""
+    plan = FaultPlan.from_spec("device_loss@2,device_return@5:2", seed=3)
+    e = plan.device_return_at(5)
+    assert e is not None and e.arg == 2.0
+    assert plan.device_return_at(4) is None
+
+    def boom(state, batch):
+        raise AssertionError("the dispatch must die before running")
+
+    wrapped = plan.wrap_step(boom, start=5)
+    with pytest.raises(ReplicaReturnSignal) as ei:
+        wrapped(None, None)
+    sig = ei.value
+    assert sig.step == 5 and sig.count == 2
+    # Deterministic given (seed, step): same arrivals every call, drawn
+    # from the absent pool, capped at what is actually absent.
+    assert sig.arrivals([0, 2, 3]) == sig.arrivals([0, 2, 3])
+    assert sig.arrivals([0, 2, 3]) == ReplicaReturnSignal(
+        5, 2, seed=3).arrivals([0, 2, 3])
+    assert len(sig.arrivals([0, 2, 3])) == 2
+    assert sig.arrivals([1]) == [1]                     # capped at absent
+    assert sig.arrivals([]) == []
+    # A start offset past the schedule never fires (replay safety).
+    plan.wrap_step(lambda s, b: (s, b), start=6)(1, 2)
+    # Vocabulary-growth pin: victims() must keep its pre-device_return
+    # seeding (frozen salt), not a len(KINDS)-derived one.
+    assert ReplicaLossError(4, 2, seed=3).victims(4) == \
+        ReplicaLossError(4, 2, seed=3).victims(4)
+
+
+def test_resize_ring_residual_shrink_grow_value_exact():
+    """The EF-residual reshard primitive: surviving (row, coordinate)
+    pairs move bit-exactly, pad swaps like the ZeRO-1 slices (zero tail
+    enforced), new rows start at zero, and every row's OWN chunk is
+    re-zeroed in the NEW geometry (the slot the owner never reads)."""
+    from ddl25spring_tpu.parallel.dp import _resize_ring_residual
+
+    # 4-way: 8 real coords, local=2, no pad. 3-way target: local=3,
+    # ring_len=9, one pad coordinate per row.
+    h = np.arange(1, 33, dtype=np.float32).reshape(4, 8)
+    for r in range(4):
+        h[r, r * 2:(r + 1) * 2] = 0.0                  # own chunk zero
+    out = _resize_ring_residual(h, (3, 9))
+    assert out.shape == (3, 9)
+    for r in range(3):
+        np.testing.assert_array_equal(out[r, 8:], 0.0)  # grown pad zero
+        np.testing.assert_array_equal(out[r, r * 3:(r + 1) * 3], 0.0)
+        keep = [c for c in range(8) if not (r * 3 <= c < (r + 1) * 3)
+                and not (r * 2 <= c < (r + 1) * 2)]
+        np.testing.assert_array_equal(out[r, keep], h[r, keep])
+    # Round trip back to 4-way: pad truncates (it is zero), row 3 returns
+    # as zeros (its pending corrections left with the topology).
+    back = _resize_ring_residual(out, (4, 8))
+    assert back.shape == (4, 8)
+    np.testing.assert_array_equal(back[3], 0.0)
+    for r in range(3):
+        keep = [c for c in range(8) if not (r * 3 <= c < (r + 1) * 3)
+                and not (r * 2 <= c < (r + 1) * 2)]
+        np.testing.assert_array_equal(back[r, keep], h[r, keep])
+        np.testing.assert_array_equal(back[r, r * 2:(r + 1) * 2], 0.0)
+    # Refusals: non-zero data in the truncated tail, bad geometry.
+    bad = np.ones((2, 8), np.float32)
+    with pytest.raises(ValueError):
+        _resize_ring_residual(bad, (2, 6))
+    with pytest.raises(ValueError):
+        _resize_ring_residual(h, (3, 8))               # 8 % 3 != 0
+
+
+@pytest.mark.parametrize(
+    "agg,spd,mirror_every,ckpt_every,expect_path,return_at,expect_replay",
+    [("zero1", 2, 1, 1000, "mirror", 5, 0),
+     ("zero1", 1, 0, 2, "checkpoint", 6, 1),
+     ("gradient", 1, 1, 1000, "mirror", 5, 0),
+     ("gradient", 2, 0, 2, "checkpoint", 5, 0)])
+def test_elastic_round_trip_4_3_4_bitwise(tmp_path, devices, agg, spd,
+                                          mirror_every, ckpt_every,
+                                          expect_path, return_at,
+                                          expect_replay):
+    """The ISSUE 16 tentpole bar: a 4→3→4 trajectory (device_loss then
+    device_return) holds the SAME bitwise standard as shrink-only — the
+    post-grow losses equal a fresh 4-replica run restored from the grow
+    recovery point, on both recovery paths, both aggregation modes, and
+    K ∈ {1, 2}. The grow rejoins the exact device the shrink lost
+    (pool-order restore), so the comparison mesh is literally the
+    original. The zero1/K=1 checkpoint variant places the return one
+    dispatch past the save cadence so the grow genuinely REPLAYS a step
+    at the restored width (the stream re-split path)."""
+    iters = 12 if spd == 2 else 8
+    el = _train(devices, 4, iters=iters, tmp=tmp_path, name="el", agg=agg,
+                spd=spd, checkpoint_every=ckpt_every,
+                resilience=ResilienceConfig(
+                    elastic=True, mirror_every=mirror_every,
+                    faults=f"device_loss@2,device_return@{return_at}"))
+    assert [(r["old_world"], r["new_world"]) for r in el.remeshes] == \
+        [(4, 3), (3, 4)]
+    assert [r["direction"] for r in el.remeshes] == ["shrink", "grow"]
+    shrink, grow = el.remeshes
+    assert grow["returned"] == shrink["lost"]          # same device back
+    assert grow["path"] == expect_path
+    assert grow["steps_replayed"] == expect_replay
+    assert grow["resume_step"] == grow["detected_at"] - expect_replay
+    assert grow["seconds"] > 0
+    assert len(el.losses) == iters and np.isfinite(el.losses).all()
+
+    m = grow["resume_step"]
+    _prune_to(tmp_path, "el", "cmp", m)
+    ref4 = _train(devices, 4, iters=iters, tmp=tmp_path, name="cmp",
+                  agg=agg, spd=spd, checkpoint_every=1000)
+    assert ref4.start_step == m
+    assert el.losses[m:] == ref4.losses                # bitwise: same floats
+
+
+def test_elastic_ring_int8_round_trip_bitwise(tmp_path, devices):
+    """Elastic × compressed wire (the composition ISSUE 14 refused):
+    4→3→4 under the int8-EF ring driver, with the ``OverlapEFState``
+    residual trees resharded N→M→N alongside the ZeRO-1 moments — the
+    post-grow trajectory is bitwise a fresh 4-replica int8-ring run
+    restored from the grow point."""
+    el = _train(devices, 4, iters=8, spd=1, tmp=tmp_path, name="el",
+                wire="int8_ef", ovl=2,
+                resilience=ResilienceConfig(
+                    elastic=True, mirror_every=1,
+                    faults="device_loss@2,device_return@5"))
+    assert [r["direction"] for r in el.remeshes] == ["shrink", "grow"]
+    assert [(r["old_world"], r["new_world"]) for r in el.remeshes] == \
+        [(4, 3), (3, 4)]
+    assert len(el.losses) == 8 and np.isfinite(el.losses).all()
+
+    m = el.remeshes[1]["resume_step"]
+    _prune_to(tmp_path, "el", "cmp", m)
+    ref4 = _train(devices, 4, iters=8, spd=1, tmp=tmp_path, name="cmp",
+                  wire="int8_ef", ovl=2, checkpoint_every=1000)
+    assert ref4.start_step == m
+    assert el.losses[m:] == ref4.losses
+
+
+def test_elastic_ring_int8_preempt_remesh_resume_bitwise(tmp_path, devices):
+    """Preempt → remesh → resume under elastic + int8 ring: a run that
+    shrinks at step 2 and is preempted at step 5 force-saves the 3-way
+    layout WITH its EF residuals; the rerun resumes and the stitched loss
+    record equals the same run without the preemption EXACTLY — residual
+    state survives both the reshard and the save/restore cycle."""
+    ref = _train(devices, 4, iters=8, spd=1, wire="int8_ef", ovl=2,
+                 resilience=ResilienceConfig(
+                     elastic=True, mirror_every=1, faults="device_loss@2"))
+    assert len(ref.losses) == 8
+
+    r1 = _train(devices, 4, iters=8, spd=1, tmp=tmp_path, name="pre",
+                wire="int8_ef", ovl=2, checkpoint_every=2,
+                resilience=ResilienceConfig(
+                    elastic=True, mirror_every=1,
+                    faults="device_loss@2,preempt@5"))
+    assert r1.preempted and len(r1.losses) < 8
+    assert len(r1.remeshes) == 1
+
+    # Rerun at the post-shrink world size: the saved layout is 3-way.
+    r2 = _train(devices, 3, iters=8, spd=1, tmp=tmp_path, name="pre",
+                wire="int8_ef", ovl=2, checkpoint_every=2)
+    assert not r2.preempted
+    assert ref.losses[r2.start_step:] == r2.losses     # bitwise resume
+    assert ref.losses[:r2.start_step] == r1.losses[:r2.start_step]
