@@ -71,7 +71,15 @@ class AutoscalePolicy:
     move per decision. The ``min_``/``max_`` bounds are hard walls — a
     decision that would cross one is simply not made (training never
     drains below ``min_train_world``; serving never below
-    ``min_serve_engines``)."""
+    ``min_serve_engines``).
+
+    ``min_headroom_frac`` > 0 arms the MEMORY guard rail (schema v9's
+    headroom SLO, telemetry/memory.py): a train→serve move is vetoed
+    while the caller-supplied pool headroom (min free fraction across
+    the engines the move would activate — ``ServingFleet.pool_headroom``)
+    sits below it. Latency pressure never justifies scaling serving up
+    into KV pools that cannot fit the load — that converts an SLO miss
+    into admission stalls (or an OOM on a real accelerator)."""
 
     ttft_slo_s: float
     max_train_world: int
@@ -83,6 +91,7 @@ class AutoscalePolicy:
     min_train_world: int = 1
     min_serve_engines: int = 1
     step: int = 1
+    min_headroom_frac: float = 0.0
 
     def __post_init__(self):
         if not self.ttft_slo_s > 0:
@@ -107,6 +116,11 @@ class AutoscalePolicy:
             raise ValueError(
                 f"need 1 <= min_serve_engines={self.min_serve_engines} <= "
                 f"max_serve_engines={self.max_serve_engines}")
+        if not 0 <= self.min_headroom_frac < 1:
+            raise ValueError(
+                f"min_headroom_frac={self.min_headroom_frac} must be in "
+                "[0, 1) — a fraction of pool capacity, and requiring a "
+                "FULLY free pool would veto every scale-out")
 
 
 class ScaleDecision(NamedTuple):
@@ -151,12 +165,19 @@ class Autoscaler:
         self._cool = 0      # ticks of enforced inaction remaining
 
     def tick(self, ttft_p95_s: Optional[float],
-             it: Optional[int] = None) -> Optional[ScaleDecision]:
+             it: Optional[int] = None,
+             headroom_frac: Optional[float] = None
+             ) -> Optional[ScaleDecision]:
         """One policy step. ``ttft_p95_s`` is the current rolling p95 TTFT
         (None = no completed requests in the window, which reads as ebb:
         an idle fleet is over-provisioned by definition). ``it`` tags the
-        telemetry event with the training iteration. Returns the decision
-        to apply, or None."""
+        telemetry event with the training iteration. ``headroom_frac`` is
+        the memory guard-rail feed (``ServingFleet.pool_headroom`` of the
+        POST-move active set): with ``policy.min_headroom_frac`` armed, a
+        train→serve move is vetoed while headroom sits below the floor —
+        the streak keeps accumulating, so the move fires the first tick
+        the pool drains enough. None (no feed) never vetoes. Returns the
+        decision to apply, or None."""
         p = self.policy
         hot = (ttft_p95_s is not None
                and ttft_p95_s >= p.pressure_frac * p.ttft_slo_s)
@@ -170,13 +191,24 @@ class Autoscaler:
         if self._cool > 0:
             self._cool -= 1
             return None
-        if (self._hot >= p.sustain
-                and self.train_world - p.step >= p.min_train_world
-                and self.serve_engines + p.step <= p.max_serve_engines):
+        want_out = (self._hot >= p.sustain
+                    and self.train_world - p.step >= p.min_train_world
+                    and self.serve_engines + p.step <= p.max_serve_engines)
+        starved = (want_out and p.min_headroom_frac > 0
+                   and headroom_frac is not None
+                   and headroom_frac < p.min_headroom_frac)
+        if want_out and not starved:
             decision = ScaleDecision(
                 "train_to_serve", self.train_world - p.step,
                 self.serve_engines + p.step, "ttft_pressure",
                 float(ttft_p95_s))
+        elif starved:
+            if self.log_fn is not None:
+                self.log_fn(f"[autoscale] train_to_serve vetoed: pool "
+                            f"headroom {headroom_frac:.2f} < floor "
+                            f"{p.min_headroom_frac:.2f} — not scaling "
+                            "serving into a pool that can't fit it")
+            return None
         elif (self._ebb >= p.sustain
                 and self.serve_engines - p.step >= p.min_serve_engines
                 and self.train_world + p.step <= p.max_train_world):

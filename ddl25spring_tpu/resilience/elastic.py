@@ -181,6 +181,14 @@ class ElasticController:
     def mirror_step(self) -> Optional[int]:
         return self._mirror[0] if self._mirror is not None else None
 
+    def mirror_bytes(self) -> int:
+        """Host RAM held by the last-good mirror (numpy nbytes walk —
+        jax-free, no device sync). The memory meter stamps this onto the
+        elastic loop's chunk-edge ``memory`` events so the recovery
+        state's footprint is a number, not a guess."""
+        from ..telemetry.memory import np_tree_bytes
+        return np_tree_bytes(self._mirror[1]) if self._mirror else 0
+
     # ----------------------------------------------------------- recovery
 
     def absent(self) -> List[int]:

@@ -167,6 +167,7 @@ class ServingFleet:
                  policy: str = "least_loaded", window_s: float = 30.0,
                  admission: str = "fcfs", speculate=None,
                  prefix_share: bool = False,
+                 memory_every: int = 0,
                  clock: Callable[[], float] = time.monotonic):
         if num_engines < 1:
             raise ValueError(f"num_engines={num_engines}")
@@ -185,9 +186,13 @@ class ServingFleet:
                                speculate=speculate,
                                prefix_share=prefix_share)
                         for i in range(num_engines)]
+        # ``memory_every`` arms each scheduler's per-engine memory meter
+        # (scheduler.py; schema v9) — every census event carries its
+        # ``engine`` tag, so the fleet's N pools stay distinguishable.
         self.scheds = [Scheduler(eng, events=events,
                                  token_events=token_events, clock=clock,
-                                 engine_id=i, admission=admission)
+                                 engine_id=i, admission=admission,
+                                 memory_every=memory_every)
                        for i, eng in enumerate(self.engines)]
         self.router = Router(self.scheds, policy=policy, window_s=window_s,
                              events=events)
@@ -298,6 +303,18 @@ class ServingFleet:
     def completed(self) -> int:
         return sum(s.completed for s in self.scheds)
 
+    def pool_headroom(self, k: Optional[int] = None) -> float:
+        """Min free-block fraction across the first ``k`` engines (default:
+        the currently active set) — the autoscaler's guard-rail feed
+        (resilience/autoscale.py ``min_headroom_frac``): scaling serving
+        UP is only safe if the pools it lands on have room. Host list
+        arithmetic only; pass a prospective ``k`` to ask "would k active
+        engines have headroom?" before committing the scale."""
+        k = self._active if k is None else max(1, min(int(k),
+                                                      len(self.engines)))
+        return min(e.allocator.free_blocks / max(1, e.allocator.capacity)
+                   for e in self.engines[:k])
+
     def compiles(self) -> List[int]:
         return [sum(len(w.compiles) for w in e.watches())
                 for e in self.engines]
@@ -339,6 +356,7 @@ def run_serving_fleet(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                       policy: str = "least_loaded", window_s: float = 30.0,
                       admission: str = "fcfs", speculate=None,
                       prefix_share: bool = False,
+                      memory_every: int = 0,
                       publish_after: Optional[int] = None,
                       publish_params: Optional[dict] = None,
                       publish_version=None) -> FleetReport:
@@ -357,7 +375,7 @@ def run_serving_fleet(params: dict, cfg: LlamaConfig, paged: PagedKVConfig,
                          token_events=token_events, policy=policy,
                          window_s=window_s, admission=admission,
                          speculate=speculate, prefix_share=prefix_share,
-                         clock=clock.now)
+                         memory_every=memory_every, clock=clock.now)
     pending = sorted(workload, key=lambda r: (r.arrival, r.rid))
     published = publish_after is None
     busy_s = 0.0

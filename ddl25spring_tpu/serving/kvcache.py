@@ -158,6 +158,35 @@ class BlockAllocator:
     def refcount(self, block: int) -> int:
         return self._refs.get(block, 0)
 
+    def fragmentation(self) -> dict:
+        """Free-list fragmentation census (schema v9 ``memory`` events):
+        ``holes`` is the number of maximal contiguous index runs the free
+        list has shattered into, ``largest_run`` the longest of them — the
+        biggest single reservation the pool could grant contiguously. An
+        empty free list is 0 holes / 0 run; a fully-free pool is exactly 1
+        hole spanning ``capacity``. O(free) over a sorted copy — called at
+        meter cadence (scheduler ticks), never per token."""
+        if not self._free:
+            return {"holes": 0, "largest_run": 0}
+        holes, run, largest = 1, 1, 1
+        ordered = sorted(self._free)
+        for prev, cur in zip(ordered, ordered[1:]):
+            if cur == prev + 1:
+                run += 1
+            else:
+                holes += 1
+                run = 1
+            largest = max(largest, run)
+        return {"holes": holes, "largest_run": largest}
+
+    @property
+    def holes(self) -> int:
+        return self.fragmentation()["holes"]
+
+    @property
+    def largest_run(self) -> int:
+        return self.fragmentation()["largest_run"]
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` blocks, or None if the pool cannot cover them (caller
         queues — never a partial grant)."""

@@ -152,7 +152,8 @@ class Scheduler:
                  token_events: bool = True,
                  clock: Callable[[], float] = time.monotonic,
                  engine_id: Optional[int] = None,
-                 admission: str = "fcfs"):
+                 admission: str = "fcfs",
+                 memory_every: int = 0):
         if admission not in ("fcfs", "sjf"):
             raise ValueError(f"admission must be 'fcfs' or 'sjf' "
                              f"(got {admission!r})")
@@ -197,6 +198,30 @@ class Scheduler:
                        if events is not None else None)
         self._spans: Dict[str, Dict[str, Span]] = {}   # rid -> open spans
         self._chunks: Dict[str, int] = {}              # rid -> chunks done
+        # Live memory census (telemetry/memory.py, schema v9): every
+        # ``memory_every``-th busy tick emits one ``memory`` event with
+        # the pool occupancy + fragmentation census and this engine's
+        # static params bytes. Default OFF (0): the serving hot loop pays
+        # nothing — not even the counter compare — unless a harness arms
+        # it; with it armed the census is host-list arithmetic only, so
+        # served streams stay bitwise identical (the smoke pins this).
+        self.memory_every = int(memory_every)
+        self.memory_meter = None
+        self._bytes_per_block = None
+        self._ticks = 0
+        if self.memory_every > 0:
+            from ..telemetry.memory import MemoryMeter, tree_state_bytes
+            self.memory_meter = MemoryMeter(events, source="serve")
+            self.memory_meter.note(
+                params_bytes=tree_state_bytes(engine.params))
+            try:
+                from .kvcache import kv_bytes_per_token
+                self._bytes_per_block = (
+                    engine.paged.block_len
+                    * kv_bytes_per_token(engine.cfg,
+                                         engine.paged.kv_dtype))
+            except Exception:
+                self._bytes_per_block = None
         self.queue: List[Request] = []
         self.records: Dict[str, RequestRecord] = {}
         self._by_slot: Dict[int, Request] = {}
@@ -370,6 +395,17 @@ class Scheduler:
             # Keep the report's token count (ServingReport.decode_tokens
             # → tokens_per_dispatch) on the same delivered basis.
             self.engine.decode_tokens -= eos_dropped
+        if self.memory_meter is not None:
+            self._ticks += 1
+            if self._ticks % self.memory_every == 0:
+                from ..telemetry.memory import allocator_census
+                self.memory_meter.sample(
+                    tick=self._ticks, in_flight=len(self._by_slot),
+                    queued=len(self.queue),
+                    **allocator_census(
+                        self.engine.allocator,
+                        bytes_per_block=self._bytes_per_block),
+                    **self._tag)
         return emitted
 
     # ---------------------------------------------------------- weight swap

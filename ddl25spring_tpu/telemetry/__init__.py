@@ -12,6 +12,11 @@ One observability subsystem the whole stack reports through:
 - ``costs``: compiled-HLO cost analysis via lower().compile()
   .cost_analysis(), guarded for jax API drift; cross-checks bench.py's
   analytic FLOPs.
+- ``memory``: unified memory observability (schema v9) — guarded
+  ``memory_analysis()`` program footprints, the jax-free ``MemoryMeter``
+  live sampler (host RSS, state/mirror bytes, KV pool occupancy +
+  fragmentation), and the ``preflight`` per-device fit estimator the
+  headroom SLO and autoscaler guard rail read.
 - ``heartbeat``: atomic liveness file consumed by experiments/watchdog.py
   as a first-class stall signal.
 - ``trace``: span contexts (trace/span/parent ids, explicit propagation)
@@ -36,6 +41,8 @@ from .heartbeat import Heartbeat, read_heartbeat
 from .introspect import (CompileWatch, FlightRecorder, NumericsSummary,
                          bind_events, make_summarizer, platform_peaks,
                          watch)
+from .memory import (MemoryMeter, allocator_census, compiled_memory,
+                     host_rss_bytes, preflight, program_memory)
 from .registry import MetricsRegistry
 from .trace import (Span, SpanContext, Spans, Tracer, device_trace,
                     trace_trees, tree_check)
@@ -55,10 +62,13 @@ def __getattr__(name: str):
 
 __all__ = [
     "CommProfile", "CompileWatch", "EventLog", "FlightRecorder",
-    "Heartbeat", "MetricsRegistry", "NumericsSummary", "SCHEMA_VERSION",
-    "Span", "SpanContext", "Spans", "Telemetry", "Tracer", "bind_events",
+    "Heartbeat", "MemoryMeter", "MetricsRegistry", "NumericsSummary",
+    "SCHEMA_VERSION",
+    "Span", "SpanContext", "Spans", "Telemetry", "Tracer",
+    "allocator_census", "bind_events", "compiled_memory",
     "default_run_id", "device_trace", "flops_crosscheck", "hlo_cost",
-    "make_summarizer", "measure_comm", "platform_peaks", "read_events",
+    "host_rss_bytes", "make_summarizer", "measure_comm", "platform_peaks",
+    "preflight", "program_memory", "read_events",
     "read_heartbeat", "trace_trees", "tree_check", "validate_event", "watch",
 ]
 

@@ -36,6 +36,16 @@ def hlo_cost(jitted_fn, *args, **kwargs) -> Optional[dict]:
         return None                       # not a jitted callable
     try:
         compiled = lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    return compiled_cost(compiled)
+
+
+def compiled_cost(compiled) -> Optional[dict]:
+    """``hlo_cost`` for an ALREADY-compiled program — the shared half of
+    the guard, split out so CompileWatch can pay ONE lower→compile and
+    feed both this cost model and ``memory.compiled_memory``."""
+    try:
         analysis = compiled.cost_analysis()
     except Exception:
         return None

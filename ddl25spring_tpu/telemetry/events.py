@@ -65,10 +65,19 @@ from typing import Any, Dict, Iterator, List, Optional
 # move between the training mesh and the serving fleet: direction plus
 # the post-transition allocation, rendered by obs_report's "scale"
 # section and marked as a Perfetto instant by trace_export).
-# Version bumps are additive: a v8 reader accepts v1–v7 streams
-# unchanged, and older readers reject v8 (the "future schema" rule in
+# v9: memory observability (telemetry/memory.py) — ``memory`` (one
+# MemoryMeter sample at a chunk edge / scheduler tick / smoke phase:
+# host RSS, training-state and elastic-mirror bytes, KV pool occupancy
+# and fragmentation, per-engine when fleet-scale); ``compile`` events
+# additionally carry the program's static device footprint
+# (``argument_bytes``/``output_bytes``/``temp_bytes``/
+# ``generated_code_bytes`` from compiled.memory_analysis()) and
+# ``manifest`` carries the preflight fit estimate — extras, so v5–v8
+# streams stay valid.
+# Version bumps are additive: a v9 reader accepts v1–v8 streams
+# unchanged, and older readers reject v9 (the "future schema" rule in
 # validate_event) rather than misread it.
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # Event types this schema version defines. The type set is CLOSED per
 # schema version: ``validate_event`` checks base fields for all types, the
@@ -80,7 +89,7 @@ EVENT_TYPES = ("manifest", "step", "fault", "fl_round", "run_end", "remesh",
                "request_enqueue", "request_prefill", "request_token",
                "request_done", "fl_cohort", "fl_tier", "span",
                "slo_violation", "numerics", "compile", "route", "deploy",
-               "speculate", "scale")
+               "speculate", "scale", "memory")
 
 _BASE_FIELDS = ("schema", "run_id", "seq", "t", "type")
 _REQUIRED: Dict[str, tuple] = {
@@ -168,6 +177,22 @@ _REQUIRED: Dict[str, tuple] = {
     # measured value behind it, ``it`` (the training chunk edge the move
     # landed on) and ``seconds`` (the re-mesh cost, when training moved).
     "scale": ("direction", "train_world", "serve_engines"),
+    # Memory observability (telemetry/memory.py MemoryMeter, schema v9):
+    # one event per sample cadence — ``source`` names the sampling site
+    # ("train" for a trainer chunk edge / step cadence, "serve" for a
+    # scheduler tick, "fleet" for a fleet census, "host" for a bare RSS
+    # trajectory point). Extras carry whatever the site can account:
+    # ``rss_bytes`` (host), ``params_bytes``/``opt_state_bytes``/
+    # ``mirror_bytes`` (training state via tree_bytes — host-side shape
+    # math, never a device sync), ``pool_used_bytes``/
+    # ``pool_capacity_bytes``/``blocks_in_use``/``holes``/``largest_run``
+    # (KV pool occupancy + fragmentation from BlockAllocator), ``engine``
+    # (fleet-scale), ``device_bytes`` (the per-device total the headroom
+    # SLO judges against slo_monitor's ``--device-bytes`` budget), and
+    # ``it``/``tick`` (stream position). Rendered by obs_report's
+    # "memory" section; the flight recorder pins the last sample as the
+    # postmortem memory census.
+    "memory": ("source",),
     # Compile/retrace accounting (introspect.CompileWatch, schema v5):
     # one event per XLA compilation of a watched jit entry point —
     # ``name`` the factory label, ``seconds`` the compiling call's wall
@@ -384,6 +409,10 @@ class EventLog:
     def compile(self, *, name: str, seconds: float,
                 **fields) -> Dict[str, Any]:
         return self.emit("compile", name=name, seconds=seconds, **fields)
+
+    # Memory observability (schema v9; telemetry/memory.py MemoryMeter).
+    def memory(self, *, source: str, **fields) -> Dict[str, Any]:
+        return self.emit("memory", source=source, **fields)
 
     # Serving fleet (schema v6; serving/fleet.py routes, serving/
     # scheduler.py swaps).

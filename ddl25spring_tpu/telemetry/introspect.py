@@ -273,6 +273,9 @@ class CompileRecord(NamedTuple):
     retrace: bool         # broke the factory's max_caches invariant
     flops: Optional[float]
     bytes_accessed: Optional[float]
+    memory: Optional[dict] = None   # static device footprint (schema v9:
+    #                       memory.compiled_memory — argument/output/temp/
+    #                       generated-code bytes), None when unaccountable
 
 
 class CompileWatch:
@@ -283,9 +286,14 @@ class CompileWatch:
     ``lower().compile()`` do not grow it on this jaxlib — probed), so the
     steady-state overhead is one int comparison per dispatch. On growth:
     the call's wall time is recorded, the program is costed via
-    ``costs.hlo_cost`` (one extra compile, paid only on an event that
-    already paid one, and only when someone is listening), and a
-    ``compile`` event is emitted to ``self.events`` when bound.
+    ``costs.compiled_cost`` AND byte-accounted via
+    ``memory.compiled_memory`` (ONE extra compile shared by both, paid
+    only on an event that already paid one, and only when someone is
+    listening), and a ``compile`` event is emitted to ``self.events``
+    when bound — carrying flops/bytes_accessed for attainment plus the
+    schema-v9 static footprint (argument/output/temp/generated-code
+    bytes), so every watched program's device byte budget is in the
+    stream.
 
     ``max_caches``: the factory's documented compile budget — serving's
     engine steps and fleet's cohort steps promise ONE program; any growth
@@ -337,14 +345,30 @@ class CompileWatch:
             retrace = (self.max_caches is not None
                        and after > self.max_caches)
             flops = bytes_accessed = None
+            mem = None
             if self._cost and self.events is not None:
-                from .costs import hlo_cost
-                hlo = hlo_cost(self._fn, *args, **kwargs)
-                if hlo is not None:
-                    flops = hlo["flops"]
-                    bytes_accessed = hlo["bytes_accessed"]
+                # One guarded lower→compile serves BOTH accountants —
+                # the flop/byte cost model (costs.compiled_cost) and the
+                # static memory footprint (memory.compiled_memory) — so
+                # observing memory costs no compile beyond what costing
+                # already paid.
+                from .costs import compiled_cost
+                from .memory import compiled_memory
+                lower = getattr(self._fn, "lower", None)
+                compiled = None
+                if lower is not None:
+                    try:
+                        compiled = lower(*args, **kwargs).compile()
+                    except Exception:
+                        compiled = None
+                if compiled is not None:
+                    hlo = compiled_cost(compiled)
+                    if hlo is not None:
+                        flops = hlo["flops"]
+                        bytes_accessed = hlo["bytes_accessed"]
+                    mem = compiled_memory(compiled)
             rec = CompileRecord(self.name, seconds, after, retrace,
-                                flops, bytes_accessed)
+                                flops, bytes_accessed, mem)
             self.compiles.append(rec)
             if retrace:
                 self.retraces += 1
@@ -358,7 +382,8 @@ class CompileWatch:
                 self.events.compile(
                     name=self.name, seconds=seconds, cache_size=after,
                     retrace=retrace, flops=flops,
-                    bytes_accessed=bytes_accessed, **meta)
+                    bytes_accessed=bytes_accessed,
+                    **(mem or {}), **meta)
         return out
 
     def __getattr__(self, attr):
@@ -483,7 +508,8 @@ class FlightRecorder:
 
     Attach as an ``EventLog`` observer (``Telemetry`` does this by
     default); every emitted event enters the ring, and the manifest /
-    latest ``numerics`` / ``compile`` events are additionally PINNED so
+    latest ``numerics`` / latest ``memory`` (the memory census) /
+    ``compile`` events are additionally PINNED so
     they survive ring eviction — a bundle must carry its own context, not
     a pointer into a stream that may be unreadable where the bundle is
     read.
@@ -510,6 +536,7 @@ class FlightRecorder:
         self.ring: List[Dict[str, Any]] = []
         self.manifest: Optional[Dict[str, Any]] = None
         self.last_numerics: Optional[Dict[str, Any]] = None
+        self.last_memory: Optional[Dict[str, Any]] = None
         self.compiles: List[Dict[str, Any]] = []
         self.bundles: List[str] = []
         self.suppressed = 0          # triggers past max_bundles
@@ -538,6 +565,12 @@ class FlightRecorder:
             self.manifest = event
         elif etype == "numerics":
             self.last_numerics = event
+        elif etype == "memory":
+            # The memory census (schema v9): the last MemoryMeter sample
+            # before the trip — RSS, state/mirror bytes, pool occupancy
+            # and fragmentation — pinned so every postmortem can say what
+            # memory looked like when things went wrong.
+            self.last_memory = event
         elif etype == "compile":
             self.compiles.append(event)
             if len(self.compiles) > 32:
@@ -559,6 +592,7 @@ class FlightRecorder:
             "attribution": (trigger or {}).get("attribution"),
             "manifest": self.manifest,
             "last_numerics": self.last_numerics,
+            "memory": self.last_memory,
             "compiles": self.compiles,
             "recent_events": list(self.ring),
             "dropped_events": 0,

@@ -152,7 +152,8 @@ def _setup_checkpoint(checkpoint_dir: Optional[str], state, iters: int,
 def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
                    mesh, start_step: int, step_fn, state, n_data: int,
                    steps_per_dispatch: int = 1, windowed: bool = False,
-                   overlap_microbatches: int = 1) -> None:
+                   overlap_microbatches: int = 1,
+                   preflight: Optional[dict] = None) -> None:
     """Open a telemetry run: one manifest event carrying the configuration
     and the step's static communication profile (telemetry/comm.py —
     measured by abstract tracing BEFORE the first real call, so the trace
@@ -193,7 +194,12 @@ def _emit_manifest(telemetry, *, trainer: str, model_cfg, train_cfg,
         # measured chip peaks, or a calibrated CPU baseline) — recorded
         # HERE so the jax-free readers (obs_report's attainment section,
         # slo_monitor's MFU floor) never have to re-derive them.
-        peaks=introspect.platform_peaks(platform))
+        peaks=introspect.platform_peaks(platform),
+        # Preflight fit estimate (telemetry/memory.py, schema v9): the
+        # predicted per-device byte budget, recorded next to the comm
+        # profile so obs_report's memory section can table
+        # preflight-vs-measured without re-deriving the model.
+        **({} if preflight is None else {"preflight": preflight}))
 
 
 def _fault_extra(step_fn) -> dict:
@@ -232,7 +238,7 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
               window_shard_fn=None, numerics=None,
               numerics_every: int = 0, compile_watch=None,
               injit_guard: bool = False,
-              on_checkpoint=None) -> LLMTrainReport:
+              on_checkpoint=None, memory_meter=None) -> LLMTrainReport:
     """The training loop both trainers share: stream replay on resume,
     per-iteration loss sinking/logging, periodic + final checkpoint saves,
     and async-honest throughput accounting (the timer starts after
@@ -468,6 +474,11 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                             dt_s=now - last_event_t,
                             steps=it - last_event_it, **extra)
                         last_event_t, last_event_it = now, it
+                        if memory_meter is not None:
+                            # Memory census rides the step-event cadence:
+                            # host-side byte math only (schema v9), no
+                            # device sync beyond the loss read above.
+                            memory_meter.sample(it=it)
                     if (naux is not None
                             and it - last_numerics_it >= numerics_every):
                         _emit_numerics(it, naux)
@@ -581,6 +592,10 @@ def _run_loop(step_fn, state, batches, train_cfg: TrainConfig, shard_fn, *,
                             dt_s=now - last_event_t,
                             steps=last_it - last_event_it, **extra)
                         last_event_t, last_event_it = now, last_it
+                        if memory_meter is not None:
+                            # Chunk-edge memory census (host byte math
+                            # only; same cadence as the step event).
+                            memory_meter.sample(it=last_it)
                     if (naux is not None
                             and last_it - last_numerics_it >= numerics_every):
                         # Chunk-edge sampling: the stacked [K] summary's
@@ -655,7 +670,8 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                       stats: Optional[ResilienceStats] = None,
                       telemetry=None, steps_per_dispatch: int = 1,
                       window_shard_fn=None,
-                      on_checkpoint=None, scale_hook=None) -> LLMTrainReport:
+                      on_checkpoint=None, scale_hook=None,
+                      memory_meter=None) -> LLMTrainReport:
     """The chunked training loop (``_run_loop`` chunked mode) with a
     replica-loss recovery path threaded through it: every dispatch runs
     under a ``ReplicaLossError``/``ReplicaReturnSignal`` catch, every
@@ -866,6 +882,13 @@ def _run_elastic_loop(controller, step_fn, state, batches,
                         dt_s=now - last_event_t,
                         steps=last_it - last_event_it, **extra)
                     last_event_t, last_event_it = now, last_it
+                    if memory_meter is not None:
+                        # Chunk-edge census; the elastic extras — mirror
+                        # bytes and the current world — make grow/shrink
+                        # memory deltas visible in the event stream.
+                        memory_meter.sample(
+                            it=last_it, world=n_data,
+                            mirror_bytes=controller.mirror_bytes())
                 delta = report.resilience.delta(prev_counters)
                 if delta:
                     telemetry.events.fault(counters=delta, it=last_it,
@@ -1384,11 +1407,28 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         resilience=resilience, stats=stats)
     if done:
         return LLMTrainReport(resilience=stats)
+    # Memory observability (telemetry/memory.py): the preflight fit
+    # estimate lands in the manifest (obs_report tables it against the
+    # measured compile-event footprint), and its per-device state figures
+    # seed the live meter that samples at every step-event cadence point.
+    # Both are guarded — a backend that can't account bytes degrades to
+    # None/empty, never blocks training.
+    pre = memory_meter = None
+    if telemetry is not None:
+        from ..telemetry import memory as memlib
+        pre = memlib.preflight(model_cfg, train_cfg, mesh=mesh,
+                               aggregation=aggregation)
+        memory_meter = memlib.MemoryMeter(telemetry.events, source="train")
+        if pre is not None:
+            memory_meter.note(params_bytes=pre["params_bytes"],
+                              opt_state_bytes=pre["opt_state_bytes"],
+                              residual_bytes=pre["residual_bytes"] or None,
+                              window_bytes=pre["window_bytes"] or None)
     _emit_manifest(telemetry, trainer="dp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
                    step_fn=step_fn, state=state, n_data=n_data,
                    steps_per_dispatch=spd, windowed=elastic,
-                   overlap_microbatches=max(1, ovl))
+                   overlap_microbatches=max(1, ovl), preflight=pre)
     if fault_plan is None and resilience is not None and resilience.faults:
         fault_plan = resilience.fault_plan()   # resolve ONCE: the elastic
         #   rebuild must re-wrap the same schedule, not a fresh counter's
@@ -1421,7 +1461,7 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             warmup_steps_excluded=warmup_steps_excluded, stats=stats,
             telemetry=telemetry, steps_per_dispatch=spd,
             window_shard_fn=window_shard, on_checkpoint=on_checkpoint,
-            scale_hook=scale_hook)
+            scale_hook=scale_hook, memory_meter=memory_meter)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
     batches = _make_batches(n_data)
@@ -1439,7 +1479,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                      numerics_every=train_cfg.numerics_every,
                      compile_watch=compile_watch,
                      injit_guard=injit_guard,
-                     on_checkpoint=on_checkpoint)
+                     on_checkpoint=on_checkpoint,
+                     memory_meter=memory_meter)
 
 
 def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,

@@ -55,9 +55,12 @@ DERIVED_FIELDS = ("mfu", "attainment")
 # gates when the candidate rises above it by more than the budget.
 # ``remesh_seconds`` / ``steps_replayed`` are the elasticity smokes'
 # recovery-cost rows (elastic_smoke / autoscale_smoke): slower re-mesh or
-# more re-trained steps is the regression.
+# more re-trained steps is the regression. ``peak_`` covers the memory
+# smoke's footprint rows (``peak_device_bytes_*`` / ``peak_rss_bytes_*``,
+# schema v9): a run whose peak bytes grew is the memory regression the
+# observability tentpole exists to catch.
 LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes",
-                            "remesh_seconds", "steps_replayed")
+                            "remesh_seconds", "steps_replayed", "peak_")
 
 
 def lower_is_better(metric: str) -> bool:

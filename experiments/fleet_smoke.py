@@ -35,14 +35,17 @@ from __future__ import annotations
 
 import argparse
 import json
-import resource
 import sys
 import time
 
+# The shared host sampler (telemetry/memory.py): one ru_maxrss reading —
+# with the Linux-KiB/macOS-bytes normalization in ONE place — feeds both
+# this smoke's RSS-bound check and the schema-v9 memory events below.
+from ddl25spring_tpu.telemetry.memory import MemoryMeter, host_rss_bytes
+
 
 def _rss_mb() -> float:
-    # ru_maxrss is KiB on Linux.
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    return (host_rss_bytes() or 0) / 2**20    # MiB, as the budget is
 
 
 def _leaves_equal(a, b) -> bool:
@@ -97,14 +100,23 @@ def run(a) -> dict:
     checks = {}
 
     tel = Telemetry(a.telemetry_dir) if a.telemetry_dir else None
-    rss_before = _rss_mb()
+    # RSS trajectory as schema-v9 memory events: one sample before the
+    # round, one after — the O(cohort)-not-O(clients) claim as stream
+    # records obs_report's memory section can table, not just a pass/fail
+    # bit in this JSON. With no telemetry dir the meter still accumulates
+    # (events=None), so the check below reads the same numbers either way.
+    meter = MemoryMeter(tel.events if tel is not None else None,
+                        source="fleet")
+    rss_before = (meter.sample(phase="before_round").get("rss_bytes")
+                  or 0) / 2**20
     fleet = FleetConfig(cohort_width=a.cohort, edges=a.edges)
     server = FleetFedAvgServer(params, apply_fn, src, xt, yt, cfg, fleet,
                                telemetry=tel)
     t0 = time.perf_counter()
     result = server.run(1)
     round_wall = time.perf_counter() - t0
-    rss_delta = _rss_mb() - rss_before
+    rss_delta = ((meter.sample(phase="after_round").get("rss_bytes")
+                  or 0) / 2**20 - rss_before)
 
     acc = result.test_accuracy[-1]
     checks["round_completed"] = bool(result.rounds == 1 and np.isfinite(acc))

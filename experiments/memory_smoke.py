@@ -1,0 +1,206 @@
+"""Memory smoke: the v9 byte-accounting chain, armed end to end in CI.
+
+The CI-sized proof (tier1.yml) of the memory observability tentpole
+(ISSUE 17): ONE process runs a chunked-DP training slice and a paged
+serving slice with their MemoryMeters armed, then CHECKS the acceptance
+bars rather than asserting it ran:
+
+- **zero overhead** — the metered training run's loss trajectory is
+  BITWISE an unmetered twin's, and the metered serving run's token
+  streams are bitwise an unmetered scheduler's (the meter is host
+  bookkeeping only: no extra dispatches, no retraces — the compile
+  events in the stream confirm);
+- **preflight within 10%** — the manifest's config-only fit estimate
+  (state + window bytes) agrees with the MEASURED ``memory_analysis``
+  argument bytes stamped on the step program's compile event;
+- **headroom SLO gates** — ``slo_monitor --check --slo-headroom`` over
+  the emitted stream passes against a roomy ``--device-bytes`` budget
+  and FAILS against one smaller than the observed peak (the breach the
+  CI gate exists to catch actually fires);
+- the stream's ``memory`` events validate strictly, carry both train
+  and serve sources, and include the pool fragmentation census.
+
+Peak footprints land as bench rows (``peak_*_bytes`` — lower is better,
+experiments/bench_compare.py) in the JSON artifact; the telemetry stream
+is written next to it for obs_report.
+
+    python -m experiments.memory_smoke --out memory-smoke.json \\
+        --telemetry-dir memory-telemetry
+
+Exit code 0 only when every bar holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run(out_path: str, telemetry_dir: str = None, iters: int = 6) -> int:
+    from ._cpu_pin import pin_cpu_virtual
+    pin_cpu_virtual()
+
+    import jax
+    import numpy as np
+
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.serving import (Engine, PagedKVConfig, Request,
+                                         Scheduler)
+    from ddl25spring_tpu.telemetry import (Telemetry, read_events,
+                                           validate_event)
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train.llm import train_llm_dp
+
+    tiny = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                       ctx_size=16)
+    serve_cfg = LlamaConfig(vocab_size=97, dmodel=32, num_heads=4,
+                            n_layers=2, ctx_size=32)
+    paged = PagedKVConfig(num_blocks=24, block_len=4, max_blocks_per_seq=8)
+    n, spd = 4, 2
+    tc = TrainConfig(batch_size=2, seq_len=16, lr=3e-3, iters=iters,
+                     data=n, steps_per_dispatch=spd)
+    mesh = make_mesh({"data": n}, devices=jax.devices()[:n])
+    checks = {}
+
+    # ---- training slice: metered vs bare, bitwise ---------------------
+    def train(tel):
+        return train_llm_dp(tiny, tc, mesh=mesh, tokenizer=ByteTokenizer(),
+                            aggregation="zero1", log_every=0, telemetry=tel)
+
+    bare = train(None)
+    telemetry = Telemetry(telemetry_dir) if telemetry_dir else Telemetry(
+        out_path + ".telemetry")
+    metered = train(telemetry)
+    checks["train_losses_bitwise"] = (
+        list(metered.losses) == list(bare.losses)
+        and bool(np.isfinite(metered.losses).all()))
+
+    # ---- serving slice: meter armed vs off, bitwise -------------------
+    params = llama.init_llama(jax.random.PRNGKey(0), serve_cfg)
+    rng = np.random.default_rng(3)
+    workload = [Request(rid=f"r{i}",
+                        prompt=tuple(int(t) for t in
+                                     rng.integers(1, 97, size=4 + i % 5)),
+                        max_new=3 + i % 4)
+                for i in range(8)]
+
+    def serve(events, memory_every):
+        eng = Engine(params, serve_cfg, paged, 2, prefill_chunk=4)
+        sched = Scheduler(eng, events=events, memory_every=memory_every)
+        for req in workload:
+            sched.submit(req, now=0.0)
+        while sched.outstanding:
+            sched.tick()
+        return sched
+
+    srv_metered = serve(telemetry.events, memory_every=2)
+    srv_plain = serve(None, memory_every=0)
+    checks["serve_streams_bitwise"] = all(
+        srv_metered.records[r.rid].tokens == srv_plain.records[r.rid].tokens
+        for r in workload)
+    telemetry.close()
+
+    # ---- the stream: valid v9 events, both sources, census fields -----
+    stream = read_events(telemetry.events_path)
+    mems = [e for e in stream if e.get("type") == "memory"]
+    sources = {e.get("source") for e in mems}
+    checks["memory_events_valid"] = (
+        bool(mems) and all(validate_event(e) == [] for e in mems))
+    checks["both_sources_sampled"] = {"train", "serve"} <= sources
+    serve_mems = [e for e in mems if e.get("source") == "serve"]
+    checks["pool_census_present"] = bool(serve_mems) and all(
+        "holes" in e and "largest_run" in e and "pool_used_bytes" in e
+        for e in serve_mems)
+
+    # ---- preflight vs measured (the fit estimator's 10% bar) ----------
+    manifest = next((e for e in stream if e.get("type") == "manifest"), {})
+    pre = manifest.get("preflight") or {}
+    measured = [e for e in stream
+                if e.get("type") == "compile" and e.get("argument_bytes")
+                and str(e.get("name", "")).startswith("train/")]
+    fit = {}
+    if pre and measured:
+        predicted = pre["state_bytes"] + pre["window_bytes"]
+        args = max(e["argument_bytes"] for e in measured)
+        fit = {"predicted_bytes": predicted, "measured_argument_bytes": args,
+               "rel_err": abs(args - predicted) / predicted}
+        checks["preflight_within_10pct"] = fit["rel_err"] < 0.10
+    else:
+        # memory_analysis legally degrades on a drifted jaxlib — the bar
+        # then is that preflight itself still produced a budget.
+        checks["preflight_within_10pct"] = bool(pre)
+
+    # Zero retraces with the meter armed (the no-extra-dispatch claim
+    # read off the compile record).
+    compiles = [e for e in stream if e.get("type") == "compile"]
+    checks["zero_retraces"] = all(not e.get("retrace") for e in compiles)
+
+    # ---- headroom gate: passes roomy, fails tight ---------------------
+    from .slo_monitor import main as slo_main
+    peak_device = max((e.get("device_bytes", 0) for e in mems), default=0)
+    roomy = slo_main([telemetry.events_path, "--check", "--slo-headroom",
+                      "0.2", "--device-bytes", str(peak_device * 10),
+                      "--no-emit"])
+    tight = slo_main([telemetry.events_path, "--check", "--slo-headroom",
+                      "0.2", "--device-bytes", str(peak_device * 1.1),
+                      "--no-emit"])
+    checks["headroom_gate_passes_roomy_budget"] = roomy == 0
+    checks["headroom_gate_catches_tight_budget"] = tight != 0
+
+    # ---- peak rows for the perf trajectory ----------------------------
+    def peak(source, field):
+        vals = [e[field] for e in mems
+                if e.get("source") == source
+                and isinstance(e.get(field), (int, float))]
+        return float(max(vals)) if vals else 0.0
+
+    rows = [
+        {"metric": "peak_device_bytes_train",
+         "value": peak("train", "device_bytes"),
+         "platform": "cpu", "variant": "memory-smoke"},
+        {"metric": "peak_device_bytes_serve",
+         "value": peak("serve", "device_bytes"),
+         "platform": "cpu", "variant": "memory-smoke"},
+        {"metric": "peak_pool_used_bytes",
+         "value": peak("serve", "pool_used_bytes"),
+         "platform": "cpu", "variant": "memory-smoke"},
+    ]
+
+    result = {
+        "ok": all(checks.values()),
+        "iters": iters,
+        "preflight": pre,
+        "fit": fit,
+        "memory_events": len(mems),
+        "sources": sorted(s for s in sources if s),
+        "peak_device_bytes": peak_device,
+        "headroom_rc": {"roomy": roomy, "tight": tight},
+        "checks": checks,
+        "rows": rows,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    if not result["ok"]:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"memory smoke FAILED checks: {failed}", file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="memory-smoke.json",
+                    help="acceptance-evidence JSON path")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="write the shared train+serve events.jsonl here "
+                         "(render with python -m experiments.obs_report)")
+    ap.add_argument("--iters", type=int, default=6)
+    a = ap.parse_args(argv)
+    return run(a.out, a.telemetry_dir, a.iters)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

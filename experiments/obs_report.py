@@ -363,6 +363,73 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                     f"p{q:g}={percentile(gbs, q):.2f} GB/s"
                     for q in (50, 99)))
 
+    mems = by_type.get("memory", [])
+    preflight = (manifest or {}).get("preflight")
+    if mems or preflight:
+        # Memory section (schema v9, telemetry/memory.py): the preflight
+        # fit estimate, the measured compiled footprint it cross-checks
+        # against (the latest compile event's memory_analysis bytes —
+        # argument bytes ARE the resident state+window, the comparable
+        # quantity), and the live meter's sampled peaks per source.
+        _section("memory")
+        if preflight:
+            parts = "  ".join(
+                f"{k.replace('_bytes', '')}={_fmt_bytes(preflight[k])}"
+                for k in ("params_bytes", "opt_state_bytes",
+                          "residual_bytes", "window_bytes",
+                          "kv_pool_bytes")
+                if isinstance(preflight.get(k), (int, float))
+                and preflight[k] > 0)
+            print(f"preflight (per device, world "
+                  f"{preflight.get('n_data', '?')}): "
+                  f"{_fmt_bytes(preflight.get('device_bytes', 0))}   "
+                  + parts)
+            # The preflight estimates the TRAINER's footprint, so prefer
+            # a train/-namespaced compile for the cross-check; a stream
+            # with only serving compiles falls back to the latest.
+            accounted = [e for e in compiles
+                         if isinstance(e.get("argument_bytes"),
+                                       (int, float))]
+            measured = next(
+                (e for e in reversed(accounted)
+                 if str(e.get("name", "")).startswith("train/")),
+                accounted[-1] if accounted else None)
+            if measured is not None and isinstance(
+                    preflight.get("state_bytes"), (int, float)):
+                predicted = (preflight["state_bytes"]
+                             + preflight.get("window_bytes", 0))
+                arg = measured["argument_bytes"]
+                rel = (abs(arg - predicted) / predicted if predicted
+                       else None)
+                print(f"measured ({measured.get('name', '?')}): args "
+                      f"{_fmt_bytes(arg)}  temp "
+                      f"{_fmt_bytes(measured.get('temp_bytes', 0))}  "
+                      f"peak {_fmt_bytes(measured.get('device_bytes', 0))}"
+                      + (f"   vs preflight {rel:+.1%}"
+                         if rel is not None else ""))
+        if mems:
+            by_source = {}
+            for e in mems:
+                by_source.setdefault(e.get("source", "?"), []).append(e)
+            for source, evs in sorted(by_source.items()):
+                peaks_ = {}
+                for e in evs:
+                    for k, v in e.items():
+                        if (k.endswith("_bytes")
+                                and isinstance(v, (int, float))):
+                            peaks_[k] = max(peaks_.get(k, 0), v)
+                last = evs[-1]
+                line = f"  {source:8s} samples {len(evs):<5d}"
+                for k in ("device_bytes", "rss_bytes", "pool_used_bytes",
+                          "mirror_bytes"):
+                    if k in peaks_:
+                        line += (f"  peak {k.replace('_bytes', '')} "
+                                 f"{_fmt_bytes(peaks_[k])}")
+                if isinstance(last.get("holes"), int):
+                    line += (f"  frag holes={last['holes']} "
+                             f"largest_run={last.get('largest_run', '?')}")
+                print(line)
+
     spans = by_type.get("span", [])
     if spans:
         # Traces section (schema v4 span events, telemetry/trace.py): the

@@ -73,6 +73,26 @@ def render_bundle(bundle: dict, out=sys.stdout) -> None:
         if nums.get("nonfinite_grads"):
             p(f"  in-jit NON-FINITE grads: {nums['nonfinite_grads']}")
 
+    mem = bundle.get("memory") or {}
+    if mem:
+        # Memory census (schema v9): the last MemoryMeter sample the
+        # recorder saw before the trip — what the bytes looked like when
+        # things went wrong, next to the numerics that tripped.
+        def _mb(k):
+            v = mem.get(k)
+            return f"{v / 2**20:.1f}M" if isinstance(v, (int, float)) else None
+        parts = [f"{k.replace('_bytes', '')} {_mb(k)}"
+                 for k in ("device_bytes", "rss_bytes", "params_bytes",
+                           "opt_state_bytes", "pool_used_bytes",
+                           "mirror_bytes")
+                 if _mb(k) is not None]
+        frag = ""
+        if mem.get("holes") is not None:
+            frag = (f"  frag holes={mem['holes']}"
+                    f" largest_run={mem.get('largest_run')}")
+        p(f"memory census ({mem.get('source', '?')}): "
+          + "  ".join(parts) + frag)
+
     compiles = bundle.get("compiles") or []
     if compiles:
         retraces = [c for c in compiles if c.get("retrace")]

@@ -74,10 +74,11 @@ def measure(n_stages: int, n_microbatches: int, *, batch_per_mb: int = 2,
         step = pp.make_pipeline_step(cfg, optimizer, mesh, n_microbatches,
                                      schedule=schedule, n_chunks=n_chunks)
         batch = pp.shard_batch(mesh, tokens)
-        lowered = step.lower(state, batch)
-        compiled = lowered.compile()
-        mem = compiled.memory_analysis()
-        temp_bytes = getattr(mem, "temp_size_in_bytes", None)
+        # The shared memory_analysis guard (telemetry/memory.py) — same
+        # lower→compile the timing loop below reuses from jit's cache.
+        from ddl25spring_tpu.telemetry.memory import program_memory
+        mem = program_memory(step, state, batch) or {}
+        temp_bytes = mem.get("temp_bytes")
 
         state, loss = step(state, batch)          # compile+first run
         jax.block_until_ready(loss)

@@ -34,6 +34,14 @@ Objectives (each enabled by passing its threshold):
   window's ``numerics`` samples whose global grad norm exceeds
   ``--gradnorm-factor`` × the window median (the drift signal that
   precedes a StepGuard skip);
+- ``--slo-headroom``  OOM-headroom floor (schema v9 ``memory`` events):
+  the free fraction of the ``--device-bytes`` budget left by the
+  window's PEAK sampled ``device_bytes`` (params + optimizer moments +
+  residuals + window + KV pool — telemetry/memory.py's census). Peak,
+  not latest: a pool that spikes into the red between samples of calm
+  is the OOM precursor this objective exists to catch. Requires
+  ``--device-bytes`` (the per-device budget to judge against — an HBM
+  size on chip, an explicit budget in CI);
 - ``--class-slo NAME:ttft_p99=S[,queue_p99=S]`` (repeatable) — PER-CLASS
   objectives over the multi-tenant fleet's ``request_done`` events
   (schema v6 ``tenant`` tags, serving/frontend.py TrafficClass):
@@ -168,6 +176,12 @@ class SLOConfig:
     # regression the tok/s floor may not catch on a lightly-loaded
     # fleet, so it is its own objective, not a silent slowdown.
     min_acceptance_rate: Optional[float] = None
+    # OOM-headroom floor (schema v9 ``memory`` events): minimum free
+    # fraction of ``device_budget_bytes`` left by the window's peak
+    # sampled ``device_bytes``. Both must be set for the objective to
+    # arm — a floor without a budget has nothing to judge against.
+    min_headroom_frac: Optional[float] = None
+    device_budget_bytes: Optional[float] = None
     # Per-traffic-class objectives (schema v6 ``tenant`` tags):
     # {class: {"ttft_p99_s": s, "queue_p99_s": s}} — the
     # serving.frontend.class_slos shape. Violations are keyed
@@ -205,6 +219,7 @@ class SLOMonitor:
         self._dts: deque = deque()      # (t, steps, dt_s)
         self._gradnorms: deque = deque()  # (t, grad_norm)
         self._spec: deque = deque()     # (t, proposed, accepted)
+        self._mem: deque = deque()      # (t, device_bytes) — schema v9
         self._flops_per_step: Optional[float] = None
         self._peak_flops: Optional[float] = None
         # Per-class rolling windows (one ttft + one wait deque per class
@@ -292,6 +307,10 @@ class SLOMonitor:
                         and isinstance(e.get("accepted"), int)
                         and e["proposed"] > 0):
                     self._spec.append((t, e["proposed"], e["accepted"]))
+            elif etype == "memory":
+                if isinstance(e.get("device_bytes"), (int, float)) \
+                        and e["device_bytes"] >= 0:
+                    self._mem.append((t, e["device_bytes"]))
             elif etype == "run_end":
                 self.run_ended = True
 
@@ -349,6 +368,7 @@ class SLOMonitor:
         horizon = now - self.cfg.window_s
         for dq in (self._ttft, self._wait, self._tokens, self._skips,
                    self._steps, self._dts, self._gradnorms, self._spec,
+                   self._mem,
                    *self._cls_ttft.values(), *self._cls_wait.values()):
             while dq and dq[0][0] < horizon:
                 dq.popleft()
@@ -441,6 +461,16 @@ class SLOMonitor:
                 if v < cfg.min_acceptance_rate:
                     measured["spec_acceptance_rate"] = (
                         v, cfg.min_acceptance_rate)
+        if (cfg.min_headroom_frac is not None and cfg.device_budget_bytes
+                and self._mem):
+            # Headroom = free fraction of the budget at the window's PEAK
+            # sample (an idle window is no verdict, same as the latency
+            # objectives). Can go negative: a census already over budget
+            # reads as negative headroom, unambiguously breached.
+            peak = max(b for _, b in self._mem)
+            v = 1.0 - peak / cfg.device_budget_bytes
+            if v < cfg.min_headroom_frac:
+                measured["headroom_frac"] = (v, cfg.min_headroom_frac)
         if cfg.max_skip_rate is not None and self._skips:
             steps = sum(n for _, n in self._steps)
             skips = sum(n for _, n in self._skips)
@@ -563,6 +593,14 @@ def main(argv=None) -> int:
                          "the window (accepted/proposed draft tokens from "
                          "schema-v7 speculate events; a degenerate draft "
                          "is an SLO breach, not a silent slowdown)")
+    ap.add_argument("--slo-headroom", type=float, default=None,
+                    help="OOM-headroom floor: minimum free fraction of "
+                         "--device-bytes left by the window's peak "
+                         "memory-event device_bytes (schema v9)")
+    ap.add_argument("--device-bytes", type=float, default=None,
+                    help="per-device byte budget --slo-headroom judges "
+                         "against (HBM size on chip; an explicit budget "
+                         "in CI)")
     ap.add_argument("--slo-gradnorm", type=float, default=None,
                     help="grad-norm spike-rate ceiling (fraction of the "
                          "window's numerics samples above "
@@ -608,7 +646,12 @@ def main(argv=None) -> int:
                     max_gradnorm_spike_rate=a.slo_gradnorm,
                     gradnorm_spike_factor=a.gradnorm_factor,
                     min_acceptance_rate=a.slo_acceptance,
+                    min_headroom_frac=a.slo_headroom,
+                    device_budget_bytes=a.device_bytes,
                     per_class=per_class)
+    if a.slo_headroom is not None and not a.device_bytes:
+        ap.error("--slo-headroom requires --device-bytes (the budget the "
+                 "free fraction is measured against)")
     emit_default = not a.check
     emit = a.emit if a.emit is not None else emit_default
     # heal=False: we are a SIDECAR on a possibly-LIVE stream — append

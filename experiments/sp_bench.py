@@ -39,11 +39,12 @@ def measure(seq_len: int, n_seq: int, *, batch: int = 2) -> Dict[str, float]:
     step = sp.make_sp_train_step(cfg, optimizer, mesh)
     tokens = jax.random.randint(jax.random.key(1), (batch, seq_len), 0,
                                 cfg.vocab_size)
-    compiled = step.lower(state, sp.shard_batch(mesh, tokens)).compile()
-    mem = compiled.memory_analysis()
-    return {"temp_bytes": float(getattr(mem, "temp_size_in_bytes", 0) or 0),
-            "argument_bytes": float(
-                getattr(mem, "argument_size_in_bytes", 0) or 0)}
+    # The shared memory_analysis guard (telemetry/memory.py): a jaxlib
+    # that can't account bytes degrades this bench to zeros, not a crash.
+    from ddl25spring_tpu.telemetry.memory import program_memory
+    mem = program_memory(step, state, sp.shard_batch(mesh, tokens)) or {}
+    return {"temp_bytes": float(mem.get("temp_bytes", 0.0)),
+            "argument_bytes": float(mem.get("argument_bytes", 0.0))}
 
 
 def main(quick: bool = False) -> Dict[str, Dict[str, float]]:
