@@ -259,6 +259,82 @@ def _pp_sidebar() -> None:
               file=sys.stderr)
 
 
+def _tp_one(spec_json: str) -> None:
+    """--tp-one mode: time a single TP-fusion sweep row and print its
+    total tokens/sec.
+
+    Child process for the same reason as ``_pp_one``: the rows need a
+    multi-device ``(data, model)`` topology, so the child pins 4 virtual
+    CPU devices before its first device use. Reduced model — the rows
+    measure the dispatch-fusion / sync-relaxation ratio, not absolute
+    throughput. ``num_heads=2`` so the Megatron head split divides at
+    model=2."""
+    import dataclasses
+    import json as _json
+
+    from experiments._cpu_pin import pin_cpu_virtual
+    pin_cpu_virtual(4)
+    from ddl25spring_tpu.bench_utils import time_tp_train_step
+    spec = _json.loads(spec_json)
+    topo = spec.pop("_mesh")
+    spd = spec.pop("_spd", 1)
+    agg = spec.pop("_agg", "gradient")
+    wire = spec.pop("_wire", None)
+    ovl = spec.pop("_ovl", 0)
+    psa = spec.pop("_psa", "")
+    cfg = dataclasses.replace(
+        LlamaConfig(), vocab_size=2048, dmodel=64, num_heads=2, n_layers=2,
+        ctx_size=64, attention_impl="xla", **spec)
+    mesh = make_mesh(topo)
+    print(time_tp_train_step(mesh, cfg, 4, steps_per_dispatch=spd,
+                             aggregation=agg, wire=wire,
+                             overlap_microbatches=ovl, psa=psa,
+                             warmup=WARMUP, timed_steps=TIMED_STEPS))
+
+
+def _tp_sidebar() -> None:
+    """TP-fusion sweep rows (CPU fallback only, stderr, never sinks the
+    bench): the PR 18 composition column measured today — per-step TP vs
+    the fused K=4 scan driver (tp.make_tp_multi_step), and the full DP×TP
+    composition (zero1 + int8 ring + scan4 through
+    tp.make_tp_overlap_multi_step). Each row is a subprocess on a
+    4-virtual-device mesh (see _tp_one); QUICK mode shortens the timed
+    window via the inherited env. The model-axis activation WIRE claim
+    (PSA) is not timed here — experiments/tp_fusion_smoke.py carries it
+    exactly, trace-time."""
+    import json as _json
+    import subprocess
+    rows = [
+        ("tp2", {"_mesh": {"model": 2}}),
+        ("tp2+scan4", {"_mesh": {"model": 2}, "_spd": 4}),
+        ("dp2tp2+z1scan4+int8ring",
+         {"_mesh": {"data": 2, "model": 2}, "_spd": 4, "_agg": "zero1",
+          "_wire": "int8_ef", "_ovl": 1}),
+    ]
+    got = {}
+    for label, spec in rows:
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--tp-one", _json.dumps(spec)],
+                capture_output=True, text=True, timeout=420)
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr.strip().splitlines()[-1]
+                                   if proc.stderr.strip()
+                                   else "child failed")
+            got[label] = float(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # one row must not sink the sidebar
+            print(f"tp row {label}: failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            continue
+        print(f"tp row {label:24s}: {got[label]:10.0f} tok/s total",
+              file=sys.stderr)
+    if "tp2" in got and "tp2+scan4" in got:
+        # The acceptance-bar line: fused-dispatch speedup, per train step.
+        print(f"tp fusion speedup (scan4 vs per-step): "
+              f"{got['tp2+scan4'] / got['tp2']:.2f}x",
+              file=sys.stderr)
+
+
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                 new_tokens: int = 128, bf16_params: bool = False,
                 kv_dtype=None) -> float:
@@ -732,11 +808,18 @@ def main():
     if PLATFORM in (None, "cpu"):
         _pp_sidebar()
 
+    # TP-fusion sidebar (ISSUE 18): same subprocess scheme — the rows
+    # need a multi-device (data, model) topology on the CPU fallback.
+    if PLATFORM in (None, "cpu"):
+        _tp_sidebar()
+
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] == "--one":
         _time_batch_one(sys.argv[2], sys.argv[3])
     elif len(sys.argv) == 3 and sys.argv[1] == "--pp-one":
         _pp_one(sys.argv[2])
+    elif len(sys.argv) == 3 and sys.argv[1] == "--tp-one":
+        _tp_one(sys.argv[2])
     else:
         main()

@@ -232,6 +232,87 @@ def time_pp_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
     return n_data * batch_size * seq * timed_steps / dt
 
 
+def time_tp_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
+                       seq: Optional[int] = None,
+                       opt_name: str = "fused",
+                       psa: str = "",
+                       wire: Optional[str] = None,
+                       warmup: int = 3, timed_steps: int = 20,
+                       steps_per_dispatch: int = 1,
+                       aggregation: str = "gradient",
+                       overlap_microbatches: int = 0) -> float:
+    """Total tokens/sec of the TENSOR-PARALLEL train step —
+    ``time_train_step``'s contract on a ``(data, model)`` mesh
+    (parallel/tp.py).
+
+    ``batch_size`` is per data shard; the return is TOTAL tokens/sec —
+    ``n_data · batch_size`` tokens per step, because model devices share
+    one batch — and the caller divides by its device count for the
+    per-chip figure. The lever spellings match ``time_pp_train_step`` one
+    for one, plus ``psa`` for the partially-synchronized-activation modes
+    (TrainConfig.psa: "" / "full" / "defer:L" / "int8_ef"):
+    ``steps_per_dispatch`` = K > 1 times the fused K-step scan driver
+    (``tp.make_tp_multi_step``, bitwise to K=1); ``overlap_microbatches``
+    = M >= 1 routes the DP×TP data-axis sync through the
+    compressed/overlapped ring (``tp.make_tp_overlap_*``), where ``wire``
+    and ``aggregation="zero1"`` compose; M = 0 is the plain pmean data
+    sync (``wire``/zero1 then unsupported, matching the trainer's
+    rules)."""
+    from .parallel import tp
+
+    seq = seq or cfg.ctx_size
+    n_data = mesh.shape.get("data", 1)
+    K = max(1, int(steps_per_dispatch))
+    M = int(overlap_microbatches)
+    params = llama.init_llama(jax.random.key(0), cfg)
+    opt = make_optimizer(opt_name)
+
+    if M >= 1:
+        maker = (tp.make_tp_overlap_multi_step if K > 1
+                 else tp.make_tp_overlap_step)
+        state, step = maker(cfg, opt, mesh, params,
+                            aggregation=aggregation, wire=wire or "fp32",
+                            overlap_microbatches=M, psa=psa)
+    else:
+        if wire is not None or aggregation != "gradient":
+            raise ValueError("TP wire compression / zero1 route through "
+                             "the ring driver: pass "
+                             "overlap_microbatches >= 1")
+        maker = tp.make_tp_multi_step if K > 1 else tp.make_tp_step
+        state, step = maker(cfg, opt, mesh, params, psa=psa,
+                            batch_shape=(batch_size, seq))
+    tokens = jax.random.randint(jax.random.key(1),
+                                (n_data * batch_size, seq),
+                                0, cfg.vocab_size)
+    if K > 1:
+        window = tp.shard_batch_window(
+            mesh, jnp.broadcast_to(tokens, (K,) + tokens.shape))
+        warm_chunks = max(1, -(-warmup // K))
+        timed_chunks = max(1, -(-timed_steps // K))
+        for _ in range(warm_chunks):
+            state, losses = step(state, window)
+        float(losses[-1])  # hard sync before the timer
+        t0 = time.perf_counter()
+        for _ in range(timed_chunks):
+            state, losses = step(state, window)
+        float(losses[-1])  # forces the whole timed chain
+        dt = time.perf_counter() - t0
+        del state
+        return n_data * batch_size * seq * timed_chunks * K / dt
+
+    batch = tp.shard_batch(mesh, tokens)
+    for _ in range(warmup):
+        state, loss = step(state, batch)
+    float(loss)  # hard sync before the timer
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, loss = step(state, batch)
+    float(loss)  # forces the whole timed chain
+    dt = time.perf_counter() - t0
+    del state
+    return n_data * batch_size * seq * timed_steps / dt
+
+
 def time_decode(cfg: LlamaConfig, batch: int, prompt_len: int = 64,
                 new_tokens: int = 128, bf16_params: bool = False,
                 kv_dtype: Optional[str] = None, reps: int = 3) -> float:

@@ -208,6 +208,25 @@ class TrainConfig:
     # tests/test_introspect.py and tests/test_pp.py). 0 disables
     # instrumentation entirely.
     numerics_every: int = 0
+    # Partially-synchronized activations (TP trainer; parallel/tp.py,
+    # after arXiv 2506.19645): how the per-sub-layer TP activation
+    # all-reduces on the forward critical path are performed. "" — the
+    # legacy Megatron path (raw in-model psum; the bitwise reference).
+    # "full" — the SAME sync positions routed through the telemetry comm
+    # wrappers: value-identical to "", but the model-axis activation wire
+    # becomes visible to telemetry/comm.py (the smoke's same-run
+    # baseline). "defer:L" — one boundary sync per L layers instead of
+    # two per layer (requires n_layers % L == 0); activations between
+    # boundaries evolve from per-shard partial sums, cutting model-axis
+    # activation wire to 1/(2L) of full sync at a pinned
+    # convergence-tolerance cost. "int8_ef" — every sub-layer sync is an
+    # int8 all-gather with a per-(model-shard, sub-layer) error-feedback
+    # residual tree carried in the train state (compress.py's EF shape),
+    # ~tp/8 of full-sync wire; gradients flow as if the sync were an
+    # exact psum. Relaxed modes hold the convergence bars pinned in
+    # tests/test_tp.py; wire budgets are gated in
+    # experiments/tp_fusion_smoke.py.
+    psa: str = ""
 
 
 @dataclass(frozen=True)

@@ -200,19 +200,37 @@ def make_int8_ef_grad_step(loss_fn: Callable,
 # comm profile (CommProfile.by_axis — the CI-gated DCN budget).
 
 
-def _int8_encode(c):
+def _int8_encode(c, scale_sync_axis=None):
     """Symmetric per-vector int8 quantization around max|c|: returns
     ``(q, s, residual)`` with ``c ≈ s·q`` and ``residual = c − s·q`` (the
-    error-feedback remainder, |residual| ≤ s/2 elementwise)."""
-    s = jnp.maximum(jnp.max(jnp.abs(c)) / 127.0,
-                    jnp.finfo(jnp.float32).tiny)
+    error-feedback remainder, |residual| ≤ s/2 elementwise).
+
+    ``scale_sync_axis``: mesh axis (or tuple of axes) to ``pmax`` the
+    scale over before quantizing (must run inside ``shard_map`` over
+    those axes). The composed drivers set this to every axis their flat
+    vector is PARTIALLY replicated over — ``"model"`` for DP×TP,
+    ``("stage"[, "model"])`` for DP×PP[×TP]: each cell's flat vector
+    mixes cell-SPECIFIC leaves (col/row shards, the stage's block slice)
+    with cell-REPLICATED leaves (norm scales, embed/head), and a per-cell
+    scale would decode the replicated entries differently per cell —
+    replicas drift apart and ``device_get``-based checkpoints silently
+    lose the divergence. A cell-agreed scale keeps every replicated
+    entry's quantize/decode (and its EF residual) bitwise identical
+    across cells; cell-specific entries just see the more conservative
+    max. Scale agreement costs one scalar pmax (raw ``lax.pmax`` — not a
+    wire-accounted collective; the scale that rides the wire is unchanged
+    in size)."""
+    m = jnp.max(jnp.abs(c))
+    if scale_sync_axis is not None:
+        m = lax.pmax(m, scale_sync_axis)
+    s = jnp.maximum(m / 127.0, jnp.finfo(jnp.float32).tiny)
     q = jnp.clip(jnp.round(c / s), -127, 127).astype(jnp.int8)
     return q, s, c - s * q.astype(jnp.float32)
 
 
 def ring_reduce_scatter(x, axis_name: str, *, wire: str = "fp32",
                         residual=None, label: str = "ring_grad",
-                        comm_scale: int = 1):
+                        comm_scale: int = 1, scale_sync_axis=None):
     """Pipelined ring reduce-scatter of a padded flat vector over
     ``lax.ppermute`` hops, with a selectable wire format for the in-flight
     chunk partials. Must run inside ``shard_map``.
@@ -256,6 +274,12 @@ def ring_reduce_scatter(x, axis_name: str, *, wire: str = "fp32",
     so the comm profile's ring accounting reproduces the analytic
     (n−1)·chunk_bytes wire formula exactly (pinned in
     tests/test_telemetry.py).
+
+    ``scale_sync_axis`` threads through to ``_int8_encode`` (see its
+    docstring): the composed DP×TP / DP×PP×TP drivers sync each hop's
+    int8 scale over the ORTHOGONAL ``model`` axis so model-replicated
+    entries of the flat vector decode identically in every model cell.
+    No effect on fp32/bf16 wire, and no change to the ring's wire bytes.
     """
     if residual is not None and wire != "int8_ef":
         # Fail loudly: the fp32/bf16 hops never touch the residual, and
@@ -283,7 +307,7 @@ def ring_reduce_scatter(x, axis_name: str, *, wire: str = "fp32",
     for t in range(n - 1):
         if wire == "int8_ef":
             c = partial + res_rolled[t]
-            q, s, err = _int8_encode(c)
+            q, s, err = _int8_encode(c, scale_sync_axis=scale_sync_axis)
             new_res.append(err)
             q = comm.ppermute(q, axis_name, perm, label=f"{label}_int8",
                               scale=comm_scale)

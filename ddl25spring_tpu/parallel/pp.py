@@ -761,22 +761,39 @@ def make_pipeline_multi_step(cfg: LlamaConfig,
 # with the pipeline schedule bodies — the one new piece is the residual /
 # moment layout, which gains a ``stage`` axis ([n_data, n_stages, ...],
 # sharded P("data", "stage")) because each stage's shard group compensates
-# its own stage's quantization error.
+# its own stage's quantization error. With a real ``model`` axis in the
+# mesh (DP×PP×TP) the layout gains one more trailing shard axis and the
+# schedule bodies run their Megatron-TP partial forms — the composition
+# rule that replaced the old model=1 hard error (see parallel/tp.py's
+# DP×TP section for the TP-mesh counterpart and the int8 cross-model
+# scale caveat, which applies to the stage/model-replicated leaves here
+# identically).
 
 
 def _pp_flat_geometry(mesh: Mesh, params):
-    """Padded flat-vector geometry of the LOCAL per-stage param tree — the
-    unit the DP×PP data-axis zero1/ring sync operates on. Every stage's
-    local tree has the same flat length (equal [L/S] block slices + the
-    stage-replicated embed/head/final_norm), so the geometry is
-    SPMD-consistent across stages. Returns ``(n, pad, local, total)`` with
-    n = the ``data`` axis size and total = the per-stage param count."""
+    """Padded flat-vector geometry of the LOCAL per-(stage[, model])-shard
+    param tree — the unit the DP×PP data-axis zero1/ring sync operates on.
+    Every stage's local tree has the same flat length (equal [L/S] block
+    slices + the stage-replicated embed/head/final_norm), and on a
+    DP×PP×TP mesh the column/row-sharded block leaves additionally
+    contribute 1/tp of their elements, identically on every model shard —
+    so the geometry is SPMD-consistent across both non-data axes. Returns
+    ``(n, pad, local, total)`` with n = the ``data`` axis size and total =
+    the per-shard param count."""
     n = mesh.shape.get("data", 1)
     n_stages = mesh.shape["stage"]
+    tp = mesh.shape.get("model", 1)
     total = 0
     for k, v in params.items():
-        size = sum(int(leaf.size) for leaf in jax.tree.leaves(v))
-        total += size // n_stages if k == "blocks" else size
+        if k == "blocks":
+            for name, leaf in v.items():
+                size = sum(int(x.size) for x in jax.tree.leaves(leaf))
+                size //= n_stages
+                if name in _TP_COL or name in _TP_ROW:
+                    size //= tp
+                total += size
+        else:
+            total += sum(int(x.size) for x in jax.tree.leaves(v))
     pad = (-total) % n
     local = (total + pad) // n
     return n, pad, local, total
@@ -791,7 +808,17 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
     stage s's d-th flat slice (the ``dp.slice_index`` data-rank ownership
     map applied per stage group); int8 EF residuals get the same layout
     (ring: ``[n, S, n·local]``; gather: ``[n, S, local]``), because each
-    (data, stage) shard compensates its OWN quantization error."""
+    (data, stage) shard compensates its OWN quantization error.
+
+    On a DP×PP×TP mesh (``model > 1`` — the composition rule the TP PSA
+    work lifted the old model=1 hard error into, see parallel/tp.py's
+    DP×TP section) every per-shard layout gains a trailing ``model``
+    axis: moments ``[n, S, tp, local]``, residuals
+    ``[n, S, tp, n·local | local]``, sharded ``P("data", "stage",
+    "model")`` — each (d, s, m) shard rings its OWN per-model-shard flat
+    slice over ``data``, so the rings on different model coordinates are
+    independent. The tp == 1 layouts stay byte-identical to the classic
+    DP×PP ones (checkpoint compatibility)."""
     if aggregation not in ("gradient", "zero1"):
         raise ValueError("the DP×PP overlap driver supports gradient/zero1 "
                          f"aggregation only (got {aggregation!r})")
@@ -805,14 +832,18 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
         raise ValueError("the DP×PP overlap driver runs the flat data ring "
                          "only; the hierarchical (dcn x data) tier is the "
                          "DP trainer's (parallel/compress.py)")
-    if mesh.shape.get("model", 1) > 1:
-        raise ValueError("the DP×PP overlap driver supports model=1 meshes "
-                         "(TP's partially-synchronized activations are "
-                         "ROADMAP item 7's next lever)")
+    tp = mesh.shape.get("model", 1)
     n_stages = mesh.shape["stage"]
+    # Leading shard axes the per-shard [local] views are wrapped in:
+    # (data, stage) on the classic DP×PP mesh, (data, stage, model) once
+    # a real model axis joins. tp == 1 keeps the old 2-axis layout so
+    # existing checkpoints round-trip byte-identically.
+    lead = 3 if tp > 1 else 2
+    dshard = (P("data", "stage", "model") if tp > 1
+              else P("data", "stage"))
     _check_layout(params.get(_LAYOUT_KEY), schedule, n_stages, n_chunks)
     n, pad, local, total = _pp_flat_geometry(mesh, params)
-    specs = param_specs(params, tp=False)
+    specs = param_specs(params, tp=tp > 1)
     sharded = shard_params(mesh, params)
     step0 = jax.device_put(jnp.zeros((), jnp.int32),
                            NamedSharding(mesh, P()))
@@ -820,8 +851,7 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
         abstract_opt = jax.eval_shape(
             optimizer.init, jax.ShapeDtypeStruct((local,), jnp.float32))
         opt_specs = jax.tree.map(
-            lambda x: (P("data", "stage") if getattr(x, "ndim", 0) >= 1
-                       else P()),
+            lambda x: dshard if getattr(x, "ndim", 0) >= 1 else P(),
             abstract_opt)
 
         def local_init(p):
@@ -830,11 +860,12 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
             mine = lax.dynamic_slice_in_dim(
                 flat, lax.axis_index("data") * local, local)
             opt = optimizer.init(mine)
-            # Vector leaves gain the (data, stage) shard axes; scalars
-            # (count) replicate — every shard steps them identically.
+            # Vector leaves gain the (data, stage[, model]) shard axes;
+            # scalars (count) replicate — every shard steps them
+            # identically.
             return jax.tree.map(
-                lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
-                           else x), opt)
+                lambda x: (x[(None,) * lead]
+                           if getattr(x, "ndim", 0) >= 1 else x), opt)
 
         opt_state = jax.jit(shard_map(
             local_init, mesh=mesh, in_specs=(specs,),
@@ -846,12 +877,12 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
         state = TrainState(sharded, opt_state, step0)
     if wire == "int8_ef":
         from .compress import OverlapEFState
-        dshard = P("data", "stage")
+        mid = (n_stages, tp) if tp > 1 else (n_stages,)
         ring_res = jax.device_put(
-            jnp.zeros((n, n_stages, n * local), jnp.float32),
+            jnp.zeros((n,) + mid + (n * local,), jnp.float32),
             NamedSharding(mesh, dshard))
         gather_res = jax.device_put(
-            jnp.zeros((n, n_stages, local), jnp.float32),
+            jnp.zeros((n,) + mid + (local,), jnp.float32),
             NamedSharding(mesh, dshard))
         state = OverlapEFState(state.params, state.opt_state, state.step,
                                ring_res, gather_res)
@@ -897,6 +928,20 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
 
     M = microbatches
     ef = wire == "int8_ef"
+    # Leading shard axes wrapping the per-shard [local] state views:
+    # (data, stage) classically, (data, stage, model) on a DP×PP×TP mesh
+    # (layout rule in _pp_overlap_setup).
+    lead = 3 if tp > 1 else 2
+    # Cell-agreed int8 scales (compress._int8_encode docstring): each
+    # (stage[, model]) cell's flat vector mixes cell-SPECIFIC leaves (the
+    # stage's block slice, its col/row shards) with leaves REPLICATED
+    # across those axes (embed/head/final-norm over stage, norm scales
+    # over model), so per-cell scales would decode the replicated entries
+    # differently per cell and silently drift the replicas apart — the
+    # stage axis always needs the agreement, the model axis joins on the
+    # composed DP×PP×TP mesh. Pinned by the replica-sync tests in
+    # tests/test_pp.py.
+    ssync = ("stage", "model") if tp > 1 else ("stage",)
 
     def local_step(state, tokens):
         params = state.params
@@ -904,7 +949,7 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
             raise ValueError(f"local batch {tokens.shape[0]} not divisible "
                              f"by overlap_microbatches={M}")
         micro = tokens.reshape((M, -1) + tokens.shape[1:])
-        ring_res = state.ring_residual[0, 0] if ef else None
+        ring_res = state.ring_residual[(0,) * lead] if ef else None
         acc = jnp.zeros((local,), jnp.float32)
         loss_sum = jnp.zeros((), jnp.float32)
         gacc = None
@@ -925,13 +970,15 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
                 # schedule (the body call above): independent dataflow.
                 red, ring_res = ring_reduce_scatter(
                     pending, "data", wire=wire, residual=ring_res,
-                    label="pp_ring_grad", comm_scale=comm_scale)
+                    label="pp_ring_grad", comm_scale=comm_scale,
+                    scale_sync_axis=ssync)
                 acc = acc + red
             pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
                               (0, pad))
         red, ring_res = ring_reduce_scatter(
             pending, "data", wire=wire, residual=ring_res,
-            label="pp_ring_grad", comm_scale=comm_scale)
+            label="pp_ring_grad", comm_scale=comm_scale,
+            scale_sync_axis=ssync)
         acc = acc + red
         g_mine = acc / (n * M)      # mean over data shards and microbatches
         loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
@@ -943,22 +990,25 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
         shard = lax.axis_index("data")
         if aggregation == "zero1":
             p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
-            # Local moment view: [1, 1, local] (data, stage)-sharded
-            # vector leaves squeeze to the flat slice; scalars pass.
+            # Local moment view: (data, stage[, model])-sharded vector
+            # leaves squeeze to the flat slice; scalars pass.
             opt_local = jax.tree.map(
-                lambda x: x[0, 0] if getattr(x, "ndim", 0) >= 3 else x,
+                lambda x: (x[(0,) * lead]
+                           if getattr(x, "ndim", 0) >= lead + 1 else x),
                 state.opt_state)
             new_p_mine, opt_local = apply_optimizer(optimizer, g_mine,
                                                     opt_local, p_mine)
             opt_state = jax.tree.map(
-                lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
-                           else x), opt_local)
+                lambda x: (x[(None,) * lead]
+                           if getattr(x, "ndim", 0) >= 1 else x), opt_local)
             if wire == "int8_ef":
                 # Compressed second leg: broadcast the param DELTA int8
                 # with its own EF residual (the compress.py zero1 rule —
                 # fp32 moments stay exact, replicas stay bitwise in sync).
                 q, s, gather_res = _int8_encode(
-                    (new_p_mine - p_mine) + state.gather_residual[0, 0])
+                    (new_p_mine - p_mine)
+                    + state.gather_residual[(0,) * lead],
+                    scale_sync_axis=ssync)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="pp_delta_gather_int8",
                                         scale=comm_scale)
@@ -977,7 +1027,8 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
         else:                       # replicated gradient update
             if wire == "int8_ef":
                 q, s, gather_res = _int8_encode(
-                    g_mine + state.gather_residual[0, 0])
+                    g_mine + state.gather_residual[(0,) * lead],
+                    scale_sync_axis=ssync)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="pp_grad_gather_int8",
                                         scale=comm_scale)
@@ -1005,8 +1056,8 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
         if ef:
             from .compress import OverlapEFState
             new_state = OverlapEFState(new_params, opt_state, step,
-                                       ring_res[None, None],
-                                       gather_res[None, None])
+                                       ring_res[(None,) * lead],
+                                       gather_res[(None,) * lead])
         else:
             new_state = TrainState(new_params, opt_state, step)
         if numerics is not None:
@@ -1042,8 +1093,9 @@ def make_pipeline_overlap_step(cfg: LlamaConfig,
     has_data = mesh.shape.get("data", 1) > 1
     local_step = _make_pp_overlap_local_step(
         cfg, optimizer, body, n_stages=n_stages,
-        n_microbatches=n_microbatches, tp=1, n=n, pad=pad, local=local,
-        total=total, microbatches=overlap_microbatches, wire=wire,
+        n_microbatches=n_microbatches, tp=mesh.shape.get("model", 1), n=n,
+        pad=pad, local=local, total=total,
+        microbatches=overlap_microbatches, wire=wire,
         aggregation=aggregation, numerics=numerics)
     out_specs = (state_specs,
                  ((P(), numerics.summary_specs()) if numerics is not None
@@ -1082,8 +1134,9 @@ def make_pipeline_overlap_multi_step(cfg: LlamaConfig,
     def multi(st, window):
         local_step = _make_pp_overlap_local_step(
             cfg, optimizer, body, n_stages=n_stages,
-            n_microbatches=n_microbatches, tp=1, n=n, pad=pad, local=local,
-            total=total, microbatches=overlap_microbatches, wire=wire,
+            n_microbatches=n_microbatches, tp=mesh.shape.get("model", 1),
+            n=n, pad=pad, local=local, total=total,
+            microbatches=overlap_microbatches, wire=wire,
             aggregation=aggregation, comm_scale=window.shape[0],
             numerics=numerics)
         return lax.scan(local_step, st, window)
@@ -1126,8 +1179,13 @@ def make_pp_numerics(params, mesh: Mesh, *, psum_data: bool = False):
     from ..telemetry import introspect
 
     if mesh.shape.get("model", 1) > 1:
-        raise ValueError("PP numerics supports model=1 meshes (per-group "
-                         "stats would differ per TP shard)")
+        raise ValueError(
+            "make_pp_numerics supports model=1 meshes: its per-group "
+            "summaries are not model-axis psum-agreed, so stats would "
+            "differ per TP shard. The overlap/ring drivers themselves DO "
+            "compose with model>1 now (DP×PP×TP, see _pp_overlap_setup); "
+            "for model-axis-agreed numerics use a TP mesh with "
+            "tp.make_tp_numerics.")
     n_stages = mesh.shape["stage"]
     local_template = {
         k: (jax.tree.map(lambda x: x[: x.shape[0] // n_stages], v)

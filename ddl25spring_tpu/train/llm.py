@@ -24,7 +24,7 @@ from ..config import LlamaConfig, ResilienceConfig, TrainConfig
 from ..data.tokens import TokenStream, sharded_batches
 from ..metrics import ResilienceStats
 from ..models import llama
-from ..parallel import dp, make_mesh, pp
+from ..parallel import dp, make_mesh, pp, tp
 from ..resilience.preemption import PreemptionHandler
 from ..telemetry import introspect
 from ..telemetry.trace import Spans, Tracer
@@ -1667,6 +1667,179 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                      stats=stats, telemetry=telemetry,
                      steps_per_dispatch=spd,
                      window_shard_fn=lambda w: pp.shard_batch_window(mesh, w),
+                     numerics=numerics,
+                     numerics_every=train_cfg.numerics_every,
+                     compile_watch=compile_watch)
+
+
+def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
+                 train_cfg: Optional[TrainConfig] = None, *,
+                 mesh=None,
+                 tokenizer=None,
+                 aggregation: str = "gradient",
+                 log_every: int = 100,
+                 log_fn: Callable[[str], None] = print,
+                 warmup_steps_excluded: int = 2,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1000,
+                 loss_sink: Optional[Callable[[int, float], None]] = None,
+                 sink_every: int = 10,
+                 resilience: Optional[ResilienceConfig] = None,
+                 fault_plan=None,
+                 telemetry=None) -> LLMTrainReport:
+    """Tensor(-x-data)-parallel tiny-Llama training; returns losses and
+    throughput.
+
+    ``train_cfg.model`` picks the TP degree (Megatron column/row layout,
+    parallel/tp.py) and ``train_cfg.data`` the data axis; each data shard
+    reads a disjoint stream window (shard_skip=5000), exactly as the
+    DP/PP trainers do. The fused-dispatch + overlapped/compressed sync
+    column (the PR 14/18 levers) composes here:
+
+    - ``train_cfg.psa`` relaxes the per-layer activation all-reduces off
+      the critical path (TrainConfig.psa doc comment: "" bitwise legacy /
+      "full" telemetry-visible baseline / "defer:L" / "int8_ef" with the
+      per-layer EF residual tree riding the checkpointed state).
+    - ``train_cfg.steps_per_dispatch`` = K > 1 drives the fused K-step
+      scan driver (tp.make_tp_multi_step) through the same chunked
+      ``_run_loop`` mode as the DP/PP trainers: one compiled, donated
+      dispatch per K steps, host work quantized to chunk edges, losses
+      bitwise-identical to K=1 (tests/test_tp.py).
+    - ``aggregation="zero1"`` + ``train_cfg.overlap_microbatches`` = M ≥ 1
+      routes the DATA-axis gradient sync through the compressed/overlapped
+      ring on the DP×TP mesh (tp.make_tp_overlap_*): ZeRO-1 moments and
+      EF residuals sharded ``(data, model)`` ride the scan carry,
+      ``train_cfg.wire`` selects the ring format (fp32/bf16/int8_ef).
+    - ``train_cfg.numerics_every`` emits in-jit numerics whose summaries
+      are model-axis psum-agreed (tp.make_tp_numerics — every shard
+      carries the same summary; losses bitwise on/off).
+
+    Still DP-trainer-only (hard errors): hierarchical DCN tiers, elastic
+    mode (which would also need EF-residual resizing for the PSA
+    activation trees), the fused in-jit guard, and ``accum_steps``.
+    ``checkpoint_dir`` enables orbax checkpoint/resume with stream
+    replay, the shared _run_loop contract — PSA EF residuals and ring
+    residuals live in the state tree, so preempt/resume is bitwise.
+    """
+    tok = tokenizer or load_tokenizer()
+    model_cfg = (model_cfg or LlamaConfig()).replace(vocab_size=tok.vocab_size)
+    train_cfg = train_cfg or TrainConfig()
+    spd = train_cfg.steps_per_dispatch
+    ovl = train_cfg.overlap_microbatches
+    psa = train_cfg.psa
+    if spd < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
+    if ovl < 0:
+        raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
+    if train_cfg.dcn != 1 or train_cfg.wire_dcn:
+        raise ValueError("hierarchical DP (TrainConfig.dcn / wire_dcn) is "
+                         "DP-trainer-only; the TP mesh has no two-level "
+                         "data tier")
+    if train_cfg.accum_steps != 1:
+        raise ValueError("accum_steps (DP gradient accumulation) is "
+                         "DP-trainer-only; use overlap_microbatches on "
+                         "the TP trainer's ring path")
+    if aggregation not in ("gradient", "zero1"):
+        raise ValueError(f"unknown aggregation {aggregation!r}: the TP "
+                         "trainer supports 'gradient' and 'zero1'")
+    if train_cfg.wire != "fp32" and ovl == 0:
+        raise ValueError(
+            "wire compression on the TP trainer routes through the DP×TP "
+            "ring driver: set overlap_microbatches >= 1 "
+            f"(got wire={train_cfg.wire!r} with overlap_microbatches=0)")
+    if aggregation == "zero1" and ovl == 0:
+        raise ValueError(
+            "TP zero1 routes the data-axis sync through the ring driver: "
+            "set overlap_microbatches >= 1")
+    if resilience is not None and resilience.elastic:
+        raise ValueError(
+            "elastic mode is DP-trainer-only: re-meshing a TP run "
+            "re-shards the Megatron column/row layout, and PSA's "
+            "activation EF residual trees would need resizing "
+            "(deferred — a named unsupported combination)")
+    if resilience is not None and resilience.injit_guard:
+        raise ValueError("injit_guard is not fused into the TP step "
+                         "bodies — use the host StepGuard "
+                         "(ResilienceConfig.guard), which works at "
+                         "dispatch granularity under steps_per_dispatch")
+    mesh = mesh or make_mesh({"data": train_cfg.data,
+                              "model": train_cfg.model})
+    if mesh.shape.get("model", 1) < 2:
+        raise ValueError("the TP trainer needs model >= 2 "
+                         "(set TrainConfig.model); model=1 is the DP "
+                         "trainer's mesh")
+    n_data = mesh.shape.get("data", 1)
+
+    params = llama.init_llama(jax.random.key(train_cfg.seed), model_cfg)
+    optimizer = _make_trainer_optimizer(train_cfg)
+    numerics = None
+    if train_cfg.numerics_every > 0:
+        # Model-axis psum-agreed in-jit numerics (tp.make_tp_numerics):
+        # the ring/zero1 path additionally psum-agrees grad stats over
+        # ``data`` (local gradients differ per data shard there — the
+        # compress.py rule).
+        numerics = tp.make_tp_numerics(params, mesh, psum_data=ovl >= 1)
+
+    if ovl >= 1:
+        # DP×TP data-axis composition (tp.make_tp_overlap_*): the
+        # model-psum-reduced gradient's data sync rides the compressed/
+        # overlapped ring; zero1 moments + EF residuals sharded
+        # (data, model) live in the state tree. psa="int8_ef" here is a
+        # named unsupported combination (_tp_overlap_setup).
+        maker = (tp.make_tp_overlap_multi_step if spd > 1
+                 else tp.make_tp_overlap_step)
+        state, step_fn = maker(
+            model_cfg, optimizer, mesh, params,
+            aggregation=aggregation, wire=train_cfg.wire,
+            overlap_microbatches=ovl, psa=psa, numerics=numerics)
+    else:
+        maker = tp.make_tp_multi_step if spd > 1 else tp.make_tp_step
+        state, step_fn = maker(
+            model_cfg, optimizer, mesh, params, psa=psa,
+            batch_shape=(train_cfg.batch_size, train_cfg.seq_len),
+            numerics=numerics)
+    # Compile/retrace accounting: the same contract as the DP/PP trainers
+    # — per-step mode promises ONE compiled program; chunked mode stamps
+    # every compile event with the COMPILING call's window size.
+    step_fn = introspect.watch(
+        step_fn,
+        name="train/tp"
+             + (f"-psa-{psa.replace(':', '')}" if psa else "")
+             + (f"-{aggregation}" if aggregation != "gradient" else "")
+             + (f"-k{spd}" if spd > 1 else "")
+             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""),
+        max_caches=(1 if spd == 1 else None),
+        events=(telemetry.events if telemetry is not None else None),
+        meta={"steps_per_dispatch": spd},
+        meta_fn=(None if spd == 1 else
+                 (lambda st, w: {"steps_per_dispatch": int(w.shape[0])})))
+    compile_watch = step_fn
+
+    stats = ResilienceStats()
+    ckpt, state, start_step, done = _setup_checkpoint(
+        checkpoint_dir, state, train_cfg.iters, log_fn,
+        resilience=resilience, stats=stats)
+    if done:
+        return LLMTrainReport(resilience=stats)
+    _emit_manifest(telemetry, trainer="tp", model_cfg=model_cfg,
+                   train_cfg=train_cfg, mesh=mesh, start_step=start_step,
+                   step_fn=step_fn, state=state, n_data=n_data,
+                   steps_per_dispatch=spd,
+                   overlap_microbatches=max(1, ovl))
+    step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
+
+    batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
+                              n_data, shard_skip=5000, seed=train_cfg.seed)
+    return _run_loop(step_fn, state, batches, train_cfg,
+                     lambda b: tp.shard_batch(mesh, b), n_data=n_data,
+                     start_step=start_step, ckpt=ckpt,
+                     checkpoint_every=checkpoint_every, loss_sink=loss_sink,
+                     sink_every=sink_every, log_every=log_every,
+                     log_fn=log_fn,
+                     warmup_steps_excluded=warmup_steps_excluded,
+                     stats=stats, telemetry=telemetry,
+                     steps_per_dispatch=spd,
+                     window_shard_fn=lambda w: tp.shard_batch_window(mesh, w),
                      numerics=numerics,
                      numerics_every=train_cfg.numerics_every,
                      compile_watch=compile_watch)

@@ -58,7 +58,10 @@ DERIVED_FIELDS = ("mfu", "attainment")
 # more re-trained steps is the regression. ``peak_`` covers the memory
 # smoke's footprint rows (``peak_device_bytes_*`` / ``peak_rss_bytes_*``,
 # schema v9): a run whose peak bytes grew is the memory regression the
-# observability tentpole exists to catch.
+# observability tentpole exists to catch. ``wire_bytes`` also pins the
+# TP-fusion smoke's ``wire_bytes_model_per_train_step`` rows (ISSUE 18):
+# the model-axis activation wire under the PSA modes must only ever
+# trend DOWN vs the committed history, same as the data-axis ring rows.
 LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes",
                             "remesh_seconds", "steps_replayed", "peak_")
 

@@ -92,6 +92,13 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                   "start_step"):
             if manifest.get(k) is not None:
                 print(f"{k}: {manifest[k]}")
+        # The activation-sync mode (TrainConfig.psa) changes what the
+        # model-axis wire rows below MEAN — echo it whenever set so a
+        # profile reader never compares a relaxed-sync run against a
+        # full-sync one without noticing.
+        psa = (manifest.get("train_cfg") or {}).get("psa")
+        if psa:
+            print(f"psa: {psa}")
 
     comm = (manifest or {}).get("comm")
     if comm:
@@ -106,10 +113,12 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                   f"payload {_fmt_bytes(agg['payload_bytes']):>12s}  "
                   f"wire {_fmt_bytes(agg['wire_bytes_per_device']):>12s}")
         # Per-mesh-axis attribution (hierarchical collectives): the DCN
-        # row IS the scarce-tier wire budget. Absent on pre-PR-12
-        # manifests — skip silently.
+        # row IS the scarce-tier wire budget, and the MODEL row is the
+        # PSA activation-sync budget (tp.psa_sync_wire_bytes) — so a
+        # single-axis TP manifest still renders the table. Absent on
+        # pre-PR-12 manifests — skip silently.
         axes = comm.get("axes")
-        if axes and len(axes) > 1:
+        if axes and (len(axes) > 1 or "model" in axes):
             print("per-axis wire budget:")
             for ax, agg in sorted(axes.items(),
                                   key=lambda kv:

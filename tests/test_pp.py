@@ -319,6 +319,87 @@ def test_pipeline_overlap_multi_step_bitwise_matches_per_step(devices, wire):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_pp_tp_composed_overlap_zero1_int8_scans_bitwise(devices):
+    """The DP×PP×TP composition (ISSUE 18's lifted model=1 rule): the
+    overlap/ring drivers run with model>1 — zero1 moments and EF
+    residuals grow a model axis ((data, stage, model)-sharded, the
+    _pp_overlap_setup layout rule) — and the K=4 fused scan reproduces
+    the per-step driver bitwise, proving the composed residual trees
+    thread the scan carry exactly as they do on the flat DP×PP mesh."""
+    optimizer = lambda: optax.adam(1e-3)  # noqa: E731
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2},
+                     devices=devices[:8])
+    batches = _pp_batches(4)
+
+    def fresh():
+        params, _ = _params_and_tokens()
+        return params
+
+    s1, step1 = pp.make_pipeline_overlap_step(
+        CFG, optimizer(), mesh, fresh(), n_microbatches=2,
+        aggregation="zero1", wire="int8_ef", overlap_microbatches=1)
+    ref = []
+    for b in batches:
+        s1, l = step1(s1, pp.shard_batch(mesh, b))
+        ref.append(float(l))
+    assert np.isfinite(ref).all(), ref
+
+    sK, stepK = pp.make_pipeline_overlap_multi_step(
+        CFG, optimizer(), mesh, fresh(), n_microbatches=2,
+        aggregation="zero1", wire="int8_ef", overlap_microbatches=1)
+    window = np.stack([np.asarray(b) for b in batches])
+    sK, losses = stepK(sK, pp.shard_batch_window(mesh, window))
+    assert [float(x) for x in np.asarray(losses)] == ref
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(sK)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("model", [1, 2])
+def test_pp_tp_composed_replicas_bitwise_in_sync(devices, model):
+    """Under the int8 legs, every replica of every param stays bitwise in
+    sync — STAGE replicas of embed/head/final-norm on the plain DP×PP
+    mesh, plus MODEL replicas of the norm scales on the composed
+    DP×PP×TP mesh. Both only hold because the int8 scales are
+    cell-agreed (compress._int8_encode scale_sync_axis: a per-cell scale
+    couples to the cell's own stage slice / col/row shard values and
+    decodes the replicated entries differently per cell — a silent-drift
+    hazard device_get-based checkpoints cannot even see)."""
+    optimizer = optax.adam(1e-3)
+    shape = {"data": 2, "stage": 2}
+    if model > 1:
+        shape["model"] = model
+    mesh = make_mesh(shape, devices=devices[:4 * model])
+    params, _ = _params_and_tokens()
+    state, step = pp.make_pipeline_overlap_step(
+        CFG, optimizer, mesh, params, n_microbatches=2,
+        aggregation="zero1", wire="int8_ef", overlap_microbatches=1)
+    for b in _pp_batches(3, key=5):
+        state, loss = step(state, pp.shard_batch(mesh, b))
+        assert np.isfinite(float(loss))
+    for leaf in jax.tree.leaves(state.params):
+        by_index = {}
+        for s in leaf.addressable_shards:
+            # s.index is a tuple of slices (unhashable): key on the
+            # (start, stop) pairs.
+            key = tuple((sl.start, sl.stop) for sl in s.index)
+            by_index.setdefault(key, []).append(np.asarray(s.data))
+        for group in by_index.values():
+            for g in group[1:]:
+                np.testing.assert_array_equal(group[0], g)
+
+
+def test_pp_numerics_model_axis_named_error(devices):
+    """make_pp_numerics stays a model=1 instrument (its per-group
+    summaries are not model-axis psum-agreed) — on a model>1 mesh it
+    dies with the NAMED error pointing at tp.make_tp_numerics, now that
+    the overlap drivers themselves DO compose with model>1."""
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2},
+                     devices=devices[:8])
+    params, _ = _params_and_tokens()
+    with pytest.raises(ValueError, match="tp.make_tp_numerics"):
+        pp.make_pp_numerics(params, mesh)
+
+
 def test_pp_zero1_vs_gradient_data_axis_wire_parity(devices):
     """ZeRO-1 on the DP×PP data axis costs the same wire as gradient
     aggregation (the ZeRO-1 allreduce-parity claim, carried to PP): both
