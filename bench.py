@@ -80,7 +80,8 @@ def peak_flops_per_chip() -> float:
 def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused",
                wire=None, steps_per_dispatch: int = 1,
                aggregation: str = "gradient",
-               overlap_microbatches: int = 0) -> float:
+               overlap_microbatches: int = 0,
+               comm_buckets: int = 1) -> float:
     """Tokens/sec for the DP train step at the given per-chip batch size.
 
     ``opt_name``: "fused" = single-pass fused Adam (ops/adam.py — same update
@@ -94,14 +95,17 @@ def time_batch(mesh, cfg, batch_size: int, opt_name: str = "fused",
     ``aggregation="zero1"`` the sharded weight update (parallel/dp.py) —
     the PR-3 hot-path levers, swept as their own variant rows.
     ``overlap_microbatches`` >= 1 routes through the overlapped ring
-    driver (parallel/compress.py), composing ``wire`` with both.
+    driver (parallel/compress.py), composing ``wire`` with both;
+    ``comm_buckets`` > 1 additionally splits each microbatch's ring into
+    the bucketed backward (ISSUE 19).
     """
     from ddl25spring_tpu.bench_utils import time_train_step
     return time_train_step(mesh, cfg, batch_size, seq=SEQ, opt_name=opt_name,
                            wire=wire, warmup=WARMUP, timed_steps=TIMED_STEPS,
                            steps_per_dispatch=steps_per_dispatch,
                            aggregation=aggregation,
-                           overlap_microbatches=overlap_microbatches)
+                           overlap_microbatches=overlap_microbatches,
+                           comm_buckets=comm_buckets)
 
 
 def _hier_row_setup(dcn: int, wire, wire_dcn, n_dev: int):
@@ -142,6 +146,7 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
     ovl = overrides.pop("_ovl", 0)
     dcn = overrides.pop("_dcn", 1)
     wire_dcn = overrides.pop("_wire_dcn", None)
+    buckets = overrides.pop("_buckets", 1)
     if opt_name == "pallas":
         # Gate the '+padam' number on a real-lowering smoke: interpret-mode
         # CPU tests validate the math, not the Mosaic compile. A broken
@@ -162,7 +167,7 @@ def _time_batch_one(overrides_json: str, batch: str) -> None:
         mesh = make_mesh({"data": n_dev})
     print(time_batch(mesh, cfg, int(batch), opt_name=opt_name, wire=wire,
                      steps_per_dispatch=spd, aggregation=agg,
-                     overlap_microbatches=ovl),
+                     overlap_microbatches=ovl, comm_buckets=buckets),
           n_dev)
 
 
@@ -516,6 +521,18 @@ def main():
                         ({**flash_overrides, "_spd": 4, "_agg": "zero1",
                           "_wire": "int8_ef", "_ovl": 2},
                          "flash-dhm+acco-m2", (64,)),
+                        # Bucketed backward (ISSUE 19): the per-microbatch
+                        # ring split into 8 VJP-emission-ordered buckets,
+                        # each dispatched as soon as its layer group's
+                        # grads exist — first hop in flight before the
+                        # full gradient materializes. Total wire bytes
+                        # are invariant in the bucket count (pinned in
+                        # tests/test_dp.py); this row prices the
+                        # per-bucket dispatch overhead against the
+                        # recovered overlap window on-chip.
+                        ({**flash_overrides, "_spd": 4, "_agg": "zero1",
+                          "_wire": "int8_ef", "_ovl": 1, "_buckets": 8},
+                         "flash-dhm+int8ring-b8", (64,)),
                         # Topology-aware two-level sync on the hybrid
                         # mesh (hier_data_mesh): fp32 reduce-scatter
                         # within each of 2 ICI islands, int8+EF across
@@ -577,6 +594,14 @@ def main():
                  ({"dtype": "float32", "_spd": 8, "_agg": "zero1",
                    "_wire": "int8_ef", "_ovl": 1},
                   "f32c+int8ring-z1k8", (8,)),
+                 # Bucketed backward (ISSUE 19): the same ring split into
+                 # 8 VJP-emission-ordered buckets — on one device this
+                 # times the per-bucket dispatch overhead (the overlap
+                 # window it buys is a multi-chip effect; the wire-bytes
+                 # invariance is pinned in tests/test_dp.py).
+                 ({"dtype": "float32", "_spd": 8, "_agg": "zero1",
+                   "_wire": "int8_ef", "_ovl": 1, "_buckets": 8},
+                  "f32c+int8ring-b8", (8,)),
                  # The two-level hierarchical driver end to end (fp32 ICI
                  # ring + int8+EF DCN ring + compressed DCN delta gather
                  # inside the K-step scan). Needs >= 2 devices for the
@@ -606,6 +631,7 @@ def main():
         ovl = ov.pop("_ovl", 0)
         dcn = ov.pop("_dcn", 1)
         wire_dcn = ov.pop("_wire_dcn", None)
+        buckets = ov.pop("_buckets", 1)
         row_mesh = mesh
         if dcn > 1:
             try:
@@ -618,7 +644,8 @@ def main():
             try:
                 tps = time_batch(row_mesh, cfg, bs, steps_per_dispatch=spd,
                                  aggregation=agg, wire=wire,
-                                 overlap_microbatches=ovl)
+                                 overlap_microbatches=ovl,
+                                 comm_buckets=buckets)
             except Exception as e:  # one variant must not sink the sweep
                 print(f"batch {bs:4d} attn={label:10s}: failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr)

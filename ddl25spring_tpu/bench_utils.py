@@ -50,7 +50,8 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
                     warmup: int = 3, timed_steps: int = 20,
                     steps_per_dispatch: int = 1,
                     aggregation: str = "gradient",
-                    overlap_microbatches: int = 0) -> float:
+                    overlap_microbatches: int = 0,
+                    comm_buckets: int = 1) -> float:
     """Total tokens/sec of the DP train step at the given per-chip batch.
 
     ``seq`` defaults to ``cfg.ctx_size``. The caller divides by its device
@@ -76,11 +77,17 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
     hierarchical mesh (hier_data_mesh), pass the per-axis dict
     ``wire={"ici": ..., "dcn": ...}`` (requires M >= 1) — the two-level
     topology-aware driver; ``dp.shard_batch``/``shard_batch_window``
-    place the batch over both data axes automatically."""
+    place the batch over both data axes automatically.
+
+    ``comm_buckets`` = B > 1 (requires M >= 1) runs the bucketed
+    backward: per-bucket ring dispatch in VJP emission order, so the
+    first hop overlaps the remaining grad compute — the ISSUE 19
+    sub-1/n chunking rows."""
     seq = seq or cfg.ctx_size
     n_dev = mesh.devices.size
     K = max(1, int(steps_per_dispatch))
     M = int(overlap_microbatches)
+    B = max(1, int(comm_buckets))
     params = llama.init_llama(jax.random.key(0), cfg)
     opt = make_optimizer(opt_name)
 
@@ -92,12 +99,16 @@ def time_train_step(mesh, cfg: LlamaConfig, batch_size: int, *,
         raise ValueError("wire compression composes with per-step gradient "
                          "aggregation only (pass overlap_microbatches >= 1 "
                          "for the composing ring driver)")
+    if M == 0 and B > 1:
+        raise ValueError("comm_buckets > 1 needs the overlapped ring driver "
+                         "(pass overlap_microbatches >= 1)")
     if M >= 1:
         from .parallel import compress
         maker = (compress.make_overlap_multi_step if K > 1
                  else compress.make_overlap_step)
         state, step = maker(loss_fn, opt, mesh, params, microbatches=M,
-                            wire=wire or "fp32", aggregation=aggregation)
+                            wire=wire or "fp32", aggregation=aggregation,
+                            comm_buckets=B)
     elif wire == "bf16":
         from .parallel import compress
         state = dp.replicate(mesh, dp.init_state(params, opt))

@@ -198,6 +198,23 @@ class TrainConfig:
     # M > 1 trades wire for overlap — see docs/COMPONENTS.md's
     # composition matrix.
     overlap_microbatches: int = 0
+    # Bucketed backward for the overlap drivers (compress.py BucketMap;
+    # all three columns — DP, DP×PP, DP×TP — and the hierarchical
+    # wire={"ici","dcn"} tier): B > 1 splits each microbatch's flat
+    # gradient into B ordered buckets aligned to the stacked ``blocks``
+    # layer groups, top-of-network first (VJP emission order), and each
+    # bucket rings independently (labels ``*ring_grad_b{b}``) with no
+    # data dependence on later buckets' grad compute — the within-
+    # backward ACCO overlap (first ring hop starts before the full
+    # gradient materializes; evidence via compress.ring_overlap_evidence,
+    # gated in experiments/comm_wire_smoke.py). ZeRO-1 moments and EF
+    # residuals become per-bucket tuples in the checkpointed state (the
+    # reshard_state bucket contract). Total ring/gather payload bytes are
+    # exactly invariant in B (the int8 ring adds one 4-byte scale per
+    # extra bucket per hop); fp32 stays bitwise vs B=1 on
+    # exact-arithmetic inputs. Requires overlap_microbatches >= 1;
+    # 1 is the legacy single-vector ring.
+    comm_buckets: int = 1
     # In-jit numerics summaries (telemetry/introspect.py; DP trainer
     # gradient/zero1, PP trainer via pp.make_pp_numerics with block
     # groups stage-qualified): N > 0 instruments the compiled step with

@@ -389,6 +389,294 @@ def hier_reduce_scatter(x, *, wire_ici: str = "fp32",
         label=f"{label}_dcn", comm_scale=comm_scale)
 
 
+# ------------------------------------------------- bucketed backward sync
+#
+# Everything below `_make_overlap_local_step` historically flattened the
+# WHOLE microbatch gradient (pt.flatten, tree order) before the first ring
+# hop — the overlap was across microbatches only, and the first hop always
+# waited on the last layer's VJP. The bucket map splits the flat geometry
+# into an ORDERED list of buckets matching reverse-mode emission order
+# (lm_head first, final_norm, the stacked `blocks` layer groups top-down,
+# the embedding last), so bucket b's ring vector is built from ONLY the
+# leaf slices it covers: its quantize/EF/ring is dataflow-independent of
+# every later bucket's grad compute, and the overlap is visible in the
+# jaxpr (``ring_overlap_evidence`` — the PR 10 evidence standard, asserted
+# in experiments/comm_wire_smoke.py). The ACCO shape ROADMAP 7b names,
+# composed with DynamiQ-style chunking (PAPERS.md).
+
+
+class BucketMap(NamedTuple):
+    """Ordered bucket decomposition of the padded flat gradient space —
+    ``dp._flat_geometry`` split into ``comm_buckets`` contiguous ranges of
+    a VJP-emission-ordered coordinate space (NOT tree order: top-of-network
+    leaves first, embedding last, see ``_ordered_pieces``).
+
+    Geometry: ``local`` (one shard's slice of the padded flat vector)
+    splits into per-bucket chunk ``sizes`` (``local//B`` each, the
+    remainder spread over the leading buckets, so ``sum(sizes) == local``
+    EXACTLY — no per-bucket padding, which is what keeps total ring wire
+    bytes invariant in the bucket count). Bucket b covers the ordered
+    coordinates ``[n·offsets[b], n·offsets[b] + n·sizes[b])``; the global
+    ``pad`` rides the tail of the LAST bucket (``pad < n ≤ n·sizes[-1]``
+    always fits). ``pieces[b]`` lists the ``(leaf_idx, start, size)``
+    slices of the tree-order leaf ravels that bucket b concatenates —
+    the static map both ``_bucket_vectors`` (grads → ring vectors) and
+    ``_scatter_buckets`` (gathered vectors → param tree) drive.
+
+    Ring ownership at B > 1 is bucket-major: shard r owns chunk r of
+    EVERY bucket, and its ZeRO-1 slice is the concat of those per-bucket
+    chunks — which is why the per-bucket EF residuals, gather residuals
+    and ZeRO-1 moments are all stored per bucket (each bucket's stack is
+    a contiguous ordered-coordinate range, the property ``reshard_state``
+    needs to pad-swap them across elastic world changes)."""
+    n: int
+    pad: int
+    local: int
+    total: int
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    pieces: Tuple[Tuple[Tuple[int, int, int], ...], ...]
+
+    @property
+    def nbuckets(self) -> int:
+        return len(self.sizes)
+
+
+def _ordered_pieces(params, leaf_local=None):
+    """VJP-emission-ordered coverage of the local flat param space: a list
+    of ``(leaf_idx, start, size)`` pieces over the tree-order leaf ravels,
+    ordered ``lm_head`` → ``final_norm`` → the stacked ``blocks`` layer
+    groups from the TOP layer down (each layer group = that layer's slice
+    of every stacked block leaf, contiguous in the leaf's own ravel) → any
+    remaining leaves (tree order) → ``embed`` last. That is the order
+    reverse-mode autodiff produces gradients in, so cutting buckets along
+    it puts the gradients that materialize FIRST into the buckets that
+    ring FIRST. Trees without the llama top-level keys (the quadratic test
+    trees, generic models) degrade to plain tree order — the bucketing
+    still reshapes the ring, it just stops tracking emission order.
+
+    ``leaf_local``: optional ``(path, leaf) -> (local_size, local_layers)``
+    override for the composed drivers whose per-cell leaf sizes differ
+    from the global shapes (DP×PP stage slices, DP×TP col/row shards);
+    ``local_layers`` is the stacked leading dim of a per-cell blocks leaf
+    (None for unstacked leaves). Defaults to the DP identity."""
+    entries = jax.tree_util.tree_flatten_with_path(params)[0]
+    head, norm, embed, other = [], [], [], []
+    blocks = []                          # (leaf_idx, per_layer_size, layers)
+    for li, (path, leaf) in enumerate(entries):
+        key = getattr(path[0], "key", None) if path else None
+        if leaf_local is not None:
+            size, layers = leaf_local(path, leaf)
+        else:
+            size = int(leaf.size)
+            layers = (int(leaf.shape[0])
+                      if key == "blocks" and getattr(leaf, "ndim", 0) >= 1
+                      else None)
+        if size == 0:
+            continue
+        whole = (li, 0, size)
+        if key == "lm_head":
+            head.append(whole)
+        elif key == "final_norm":
+            norm.append(whole)
+        elif key == "embed":
+            embed.append(whole)
+        elif key == "blocks" and layers and size % layers == 0:
+            blocks.append((li, size // layers, layers))
+        else:
+            other.append(whole)
+    pieces = head + norm
+    if blocks:
+        n_layers = max(layers for _, _, layers in blocks)
+        for layer in range(n_layers - 1, -1, -1):
+            for li, per_layer, layers in blocks:
+                if layer < layers:
+                    pieces.append((li, layer * per_layer, per_layer))
+    return pieces + other + embed
+
+
+def make_bucket_map(params, n: int, comm_buckets: int,
+                    *, leaf_local=None) -> BucketMap:
+    """Build the ``BucketMap`` for ``params`` over an ``n``-shard data
+    world: ``_ordered_pieces``'s emission-ordered coverage, cut at the
+    ``n·sizes[b]`` bucket boundaries (a piece straddling a boundary splits
+    — buckets are exact coordinate ranges, never rounded to leaf edges).
+    Raises for non-positive or oversubscribed bucket counts (every bucket
+    needs ≥ 1 coordinate per shard)."""
+    B = int(comm_buckets)
+    if B < 1:
+        raise ValueError(f"comm_buckets must be >= 1 (got {comm_buckets})")
+    pieces = _ordered_pieces(params, leaf_local)
+    total = sum(sz for _, _, sz in pieces)
+    pad = (-total) % n
+    local = (total + pad) // n
+    if B > local:
+        raise ValueError(
+            f"comm_buckets={B} exceeds the per-shard slice ({local} "
+            f"coordinates at data world {n}) — every bucket needs at "
+            "least one coordinate per shard")
+    base, rem = divmod(local, B)
+    sizes = tuple(base + (1 if b < rem else 0) for b in range(B))
+    offsets = tuple(sum(sizes[:b]) for b in range(B))
+    buckets, cur = [], []
+    need = n * sizes[0]
+    for li, st, sz in pieces:
+        while sz:
+            if need == 0:
+                buckets.append(tuple(cur))
+                cur = []
+                need = n * sizes[len(buckets)]
+            take = min(sz, need)
+            cur.append((li, st, take))
+            st += take
+            sz -= take
+            need -= take
+    buckets.append(tuple(cur))           # last bucket; the pad fills `need`
+    return BucketMap(n, pad, local, total, sizes, offsets, tuple(buckets))
+
+
+def _bucket_vectors(bm: BucketMap, tree):
+    """Per-bucket fp32 ring vectors ``[n·sizes[b]]`` from a tree's leaves.
+    Each bucket's vector concatenates ONLY the leaf slices its pieces
+    cover, so bucket b's vector — and everything downstream of it
+    (quantize, EF, ring hops) — carries no data dependence on any leaf
+    outside bucket b: the jaxpr-visible overlap. The global pad is
+    appended to the last bucket's tail (its coordinates are the tail of
+    the ordered space)."""
+    leaves = jax.tree.leaves(tree)
+    vecs = []
+    for b, pieces in enumerate(bm.pieces):
+        parts = [leaves[li].reshape(-1)[st:st + sz].astype(jnp.float32)
+                 for li, st, sz in pieces]
+        if b == bm.nbuckets - 1 and bm.pad:
+            parts.append(jnp.zeros((bm.pad,), jnp.float32))
+        vecs.append(parts[0] if len(parts) == 1
+                    else jnp.concatenate(parts))
+    return vecs
+
+
+def _scatter_buckets(bm: BucketMap, vecs, ref_tree):
+    """Inverse of ``_bucket_vectors``: reassemble a tree from per-bucket
+    FULL vectors ``[n·sizes[b]]`` (every shard's chunk present — the
+    post-all-gather layout), casting each leaf back to its reference
+    dtype. The last bucket's pad tail is simply never referenced."""
+    ref_leaves, treedef = jax.tree.flatten(ref_tree)
+    per_leaf = {}
+    for b, pieces in enumerate(bm.pieces):
+        pos = 0
+        for li, st, sz in pieces:
+            per_leaf.setdefault(li, []).append((st, b, pos, sz))
+            pos += sz
+    out = []
+    for li, ref in enumerate(ref_leaves):
+        segs = sorted(per_leaf[li])
+        parts = [vecs[b][pos:pos + sz] for _, b, pos, sz in segs]
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        out.append(flat.reshape(ref.shape).astype(ref.dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+def _bucket_slices(bm: BucketMap, gathered, lead: int = 1):
+    """Split a rank-major gathered stack back into per-bucket full
+    vectors: ``gathered`` is ``[ranks·local]`` (each rank's slot its
+    owned concat-of-bucket-chunks slice — the flat all-gather, the DCN
+    q_all, or the two-leg hierarchical gather, whose S·D rows compose in
+    exactly the s·D + d ownership order), so bucket b's full vector is
+    the ``[:, offsets[b]:offsets[b]+sizes[b]]`` stripe re-flattened.
+    ``lead = D`` handles the one layout where each rank's slot is itself
+    a concat of ``[D·sizes[b]]`` superchunk blocks (the ICI gather of
+    per-bucket DCN-decoded superchunks in the hierarchical int8 path)."""
+    g = gathered.reshape(-1, lead * bm.local)
+    return [g[:, lead * bm.offsets[b]:
+              lead * (bm.offsets[b] + bm.sizes[b])].reshape(-1)
+            for b in range(bm.nbuckets)]
+
+
+def _find_ppermute_jaxpr(jaxpr):
+    """Depth-first search for the (sub)jaxpr whose equation list directly
+    contains ``ppermute`` equations — the shard_map body the ring hops
+    live in. Returns None when the program has no ring."""
+    if any(e.primitive.name == "ppermute" for e in jaxpr.eqns):
+        return jaxpr
+    for eqn in jaxpr.eqns:
+        subs = []
+        for v in eqn.params.values():
+            cand = v if isinstance(v, (tuple, list)) else (v,)
+            for c in cand:
+                inner = getattr(c, "jaxpr", c)
+                if hasattr(inner, "eqns"):
+                    subs.append(inner)
+        for sub in subs:
+            found = _find_ppermute_jaxpr(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def ring_overlap_evidence(fn, *args):
+    """Structural (jaxpr-level) proof of the bucketed-backward overlap —
+    the PR 10 evidence standard applied to ISSUE 19's sub-gradient
+    chunking. Traces ``fn(*args)`` (no execution), finds the shard_map
+    body carrying the ring's ``ppermute`` hops, and classifies each hop
+    against the TEXTUALLY LAST ``scan`` equation — the final microbatch's
+    backward scan, i.e. the point where the full gradient has
+    materialized. Returns::
+
+        {"n_ring_hops":        total ppermute equations,
+         "waited_hops":        hops data-dependent on the last scan,
+         "independent_hops":   hops with NO such dependence,
+         "overlap_fraction":   independent / total,
+         "first_hop_independent": bucket 0's first hop carries no data
+                                  dependence on the last backward scan}
+
+    Unbucketed (B = 1, M = 1) the single ring vector includes the
+    embedding gradient, every hop descends from the backward scan, and
+    ``overlap_fraction`` is 0.0 — the sanity negative. Bucketed, the
+    top-of-network buckets' hops (and at M > 1 every non-final
+    microbatch's hops) are independent: ``first_hop_independent`` is the
+    acceptance predicate comm_wire_smoke asserts, and
+    ``overlap_fraction`` is the higher-is-better row it emits for
+    bench_compare."""
+    closed = jax.make_jaxpr(fn)(*args)
+    inner = _find_ppermute_jaxpr(closed.jaxpr)
+    if inner is None:
+        return {"n_ring_hops": 0, "waited_hops": 0, "independent_hops": 0,
+                "overlap_fraction": 0.0, "first_hop_independent": False}
+    eqns = list(inner.eqns)
+    hops = [e for e in eqns if e.primitive.name == "ppermute"]
+    scans = [e for e in eqns if e.primitive.name == "scan"]
+    if not scans:
+        # No scanned layer stack in the loss — every hop trivially
+        # "independent"; report zero evidence rather than free credit.
+        return {"n_ring_hops": len(hops), "waited_hops": 0,
+                "independent_hops": 0, "overlap_fraction": 0.0,
+                "first_hop_independent": False}
+    anchor = scans[-1]
+    consumers = {}
+    for e in eqns:
+        for v in e.invars:
+            if v.__class__.__name__ == "Literal":
+                continue
+            consumers.setdefault(v, []).append(e)
+    # Transitive descendants of the anchor scan, equations treated
+    # atomically (any invar produced downstream taints the whole eqn).
+    desc, stack = set(), [anchor]
+    while stack:
+        e = stack.pop()
+        if id(e) in desc:
+            continue
+        desc.add(id(e))
+        for v in e.outvars:
+            stack.extend(consumers.get(v, ()))
+    waited = sum(1 for h in hops if id(h) in desc)
+    independent = len(hops) - waited
+    return {"n_ring_hops": len(hops), "waited_hops": waited,
+            "independent_hops": independent,
+            "overlap_fraction": (independent / len(hops)) if hops else 0.0,
+            "first_hop_independent": bool(hops)
+            and id(hops[0]) not in desc}
+
+
 class OverlapEFState(NamedTuple):
     """TrainState + the two error-feedback residual trees of the int8 ring
     driver, both sharded over the data-parallel world and zero at init:
@@ -415,7 +703,13 @@ class OverlapEFState(NamedTuple):
     tuple with a ``stage`` axis spliced in — ring ``[n, S, n·local]``,
     gather ``[n, S, local]``, sharded ``P("data", "stage")`` — because
     each (data, stage) shard compensates its OWN stage slice's
-    quantization error (same bars, pinned in tests/test_pp.py)."""
+    quantization error (same bars, pinned in tests/test_pp.py).
+
+    At ``comm_buckets > 1`` both fields are TUPLES of per-bucket arrays
+    (ring ``[n, ring_n·sizes[b]]``, gather ``[n·sizes[b]]``) — same
+    semantics per bucket, stored per bucket so each stack is a contiguous
+    ordered-coordinate range ``dp.reshard_state`` can pad-swap across
+    elastic world changes (see ``BucketMap``)."""
     params: Any
     opt_state: Any
     step: jnp.ndarray
@@ -423,7 +717,47 @@ class OverlapEFState(NamedTuple):
     gather_residual: Any
 
 
-def _overlap_setup(mesh: Mesh, params, optimizer, wire, aggregation: str):
+def _zero1_bucket_setup(optimizer, mesh: Mesh, params, bm: BucketMap,
+                        dpart):
+    """ZeRO-1 initialization at ``comm_buckets > 1``: one optimizer state
+    PER BUCKET, each over that bucket's per-shard chunk (``[sizes[b]]``
+    locally, ``[n·sizes[b]]`` globally). Elementwise optimizers make the
+    split value-identical to ``dp._zero1_setup``'s single ``[local]``
+    slice — the tuple exists for the STORAGE layout: each bucket's moment
+    stack is a contiguous ordered-coordinate range, which is what lets
+    ``reshard_state`` pad-swap it across elastic world changes (a single
+    ``[n·local]`` stack at B > 1 would interleave buckets rank-major and
+    scramble under a world resize)."""
+    from .dp import slice_index
+
+    specs = []
+    for sz in bm.sizes:
+        abstract = jax.eval_shape(
+            optimizer.init, jax.ShapeDtypeStruct((sz,), jnp.float32))
+        specs.append(jax.tree.map(
+            lambda x: P(dpart) if getattr(x, "ndim", 0) >= 1 else P(),
+            abstract))
+    opt_specs = tuple(specs)
+
+    def local_init(params):
+        shard = slice_index(mesh)
+        vecs = _bucket_vectors(bm, params)
+        return tuple(
+            optimizer.init(lax.dynamic_slice_in_dim(
+                vecs[b], shard * bm.sizes[b], bm.sizes[b]))
+            for b in range(bm.nbuckets))
+
+    opt_state = jax.jit(shard_map(
+        local_init, mesh=mesh, in_specs=P(),
+        out_specs=opt_specs, check_vma=False))(params)
+    state = TrainState(replicate(mesh, params), opt_state,
+                       jax.device_put(jnp.zeros((), jnp.int32),
+                                      NamedSharding(mesh, P())))
+    return state, opt_specs
+
+
+def _overlap_setup(mesh: Mesh, params, optimizer, wire, aggregation: str,
+                   comm_buckets: int = 1):
     """State + shard specs + flat geometry for the overlap driver. The
     zero1 variant reuses ``dp._zero1_setup`` wholesale, so the slice the
     ring chunk lands on IS the slice the sharded update owns (including
@@ -431,10 +765,14 @@ def _overlap_setup(mesh: Mesh, params, optimizer, wire, aggregation: str):
 
     ``wire``: a format string for the flat data ring, or the per-axis dict
     ``{"ici": ..., "dcn": ...}`` selecting the two-level path on a
-    hierarchical mesh. Returns ``(state, specs, dpart, n, pad, local,
-    total, hier_shape)`` — ``dpart`` the normalized data PartitionSpec
+    hierarchical mesh. ``comm_buckets > 1`` selects the bucketed backward
+    (``BucketMap``): the EF residuals, gather residuals and ZeRO-1 moments
+    all become per-bucket tuples, and the returned ``bm`` drives the
+    bucketed local step. Returns ``(state, specs, dpart, n, pad, local,
+    total, hier_shape, bm)`` — ``dpart`` the normalized data PartitionSpec
     entry (dp.data_partition), ``hier_shape`` = ``(D, S)`` for the
-    two-level path, None for the flat ring."""
+    two-level path, None for the flat ring, ``bm`` None at
+    ``comm_buckets == 1`` (the exact legacy path)."""
     from .dp import _flat_geometry, _zero1_setup, data_partition
 
     if aggregation not in ("gradient", "zero1"):
@@ -471,31 +809,56 @@ def _overlap_setup(mesh: Mesh, params, optimizer, wire, aggregation: str):
         ef = wire == "int8_ef"
     dpart = data_partition(mesh)
     n, pad, local, total = _flat_geometry(mesh, params)
+    if int(comm_buckets) < 1:
+        raise ValueError(
+            f"comm_buckets must be >= 1 (got {comm_buckets})")
+    bm = (make_bucket_map(params, n, comm_buckets)
+          if int(comm_buckets) > 1 else None)
     if aggregation == "zero1":
-        base, opt_specs, *_ = _zero1_setup(optimizer, mesh, params)
+        if bm is not None:
+            base, opt_specs = _zero1_bucket_setup(
+                optimizer, mesh, params, bm, dpart)
+        else:
+            base, opt_specs, *_ = _zero1_setup(optimizer, mesh, params)
     else:
         base = replicate(mesh, init_state(params, optimizer))
         opt_specs = P()
     if ef:
-        ring_len = (hier_shape[0] if hier_shape is not None else n) * local
+        ring_n = hier_shape[0] if hier_shape is not None else n
         dshard = P(dpart)
-        ring_res = jax.device_put(jnp.zeros((n, ring_len), jnp.float32),
-                                  NamedSharding(mesh, dshard))
-        gather_res = jax.device_put(jnp.zeros((n * local,), jnp.float32),
-                                    NamedSharding(mesh, dshard))
+        if bm is not None:
+            ring_res = tuple(
+                jax.device_put(jnp.zeros((n, ring_n * sz), jnp.float32),
+                               NamedSharding(mesh, dshard))
+                for sz in bm.sizes)
+            gather_res = tuple(
+                jax.device_put(jnp.zeros((n * sz,), jnp.float32),
+                               NamedSharding(mesh, dshard))
+                for sz in bm.sizes)
+            specs = OverlapEFState(P(), opt_specs, P(),
+                                   (dshard,) * bm.nbuckets,
+                                   (dshard,) * bm.nbuckets)
+        else:
+            ring_res = jax.device_put(
+                jnp.zeros((n, ring_n * local), jnp.float32),
+                NamedSharding(mesh, dshard))
+            gather_res = jax.device_put(
+                jnp.zeros((n * local,), jnp.float32),
+                NamedSharding(mesh, dshard))
+            specs = OverlapEFState(P(), opt_specs, P(), dshard, dshard)
         state = OverlapEFState(base.params, base.opt_state, base.step,
                                ring_res, gather_res)
-        specs = OverlapEFState(P(), opt_specs, P(), dshard, dshard)
     else:
         state = base
         specs = TrainState(P(), opt_specs, P())
-    return state, specs, dpart, n, pad, local, total, hier_shape
+    return state, specs, dpart, n, pad, local, total, hier_shape, bm
 
 
 def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                              local: int, total: int, *, microbatches: int,
                              wire, aggregation: str,
                              comm_scale: int = 1, hier_shape=None,
+                             bucket_map=None,
                              guard_nonfinite: bool = False,
                              numerics=None) -> Callable:
     """The per-shard overlapped step body shared by ``make_overlap_step``
@@ -547,8 +910,28 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
     not bitwise (M=1 differs from them only by the ring-vs-linear
     reduction order; see ``ring_reduce_scatter``). The compressed gather
     legs broadcast one payload that every shard applies identically, so
-    replicas stay bitwise in sync in every mode and topology."""
+    replicas stay bitwise in sync in every mode and topology.
+
+    ``bucket_map`` (a ``BucketMap``, None for the legacy single-vector
+    path) selects the bucketed backward: each microbatch gradient is
+    produced as per-bucket ring vectors (``_bucket_vectors`` — bucket b
+    built from ONLY the leaf slices it covers, in VJP emission order), and
+    each bucket rings independently under its own label
+    (``ring_grad_b{b}``), so bucket b's quantize/EF/ring carries no data
+    dependence on bucket b+1..'s grad compute — the within-backward
+    overlap (``ring_overlap_evidence``), on top of the across-microbatch
+    overlap above. A shard's owned slice becomes the concat of its
+    per-bucket chunks; the gather legs stay ONE collective of ``local``
+    elements in every mode (buckets are extracted from the gathered stack
+    with static slices), so gather-leg bytes and collective counts — and,
+    in fp32/bf16, total wire bytes — are exactly invariant in the bucket
+    count (the int8 ring adds one 4-byte scale sideband per extra bucket
+    per hop, pinned analytically in the smoke). EF residuals, gather
+    residuals and ZeRO-1 moments are per-bucket tuples (see
+    ``_zero1_bucket_setup`` for why)."""
     M = microbatches
+    bm = bucket_map
+    B = bm.nbuckets if bm is not None else 1
     hier = hier_shape is not None
     if hier:
         D, S = hier_shape
@@ -557,14 +940,29 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
     else:
         ef = wire == "int8_ef"
 
-    def _reduce(pending, ring_res):
+    def _reduce(pending, ring_res, bucket=None):
+        label = "ring_grad" if bucket is None else f"ring_grad_b{bucket}"
         if hier:
             return hier_reduce_scatter(
                 pending, wire_ici=wire_ici, wire_dcn=wire_dcn,
-                residual=ring_res, comm_scale=comm_scale)
+                residual=ring_res, comm_scale=comm_scale, label=label)
         return ring_reduce_scatter(pending, "data", wire=wire,
                                    residual=ring_res,
-                                   comm_scale=comm_scale)
+                                   comm_scale=comm_scale, label=label)
+
+    def _reduce_all(pending, ring_res):
+        # pending: the flat vector (bm None) or the per-bucket vector
+        # list; ring_res mirrors it. Returns this shard's owned [local]
+        # slice (concat of per-bucket chunks when bucketed).
+        if bm is None:
+            return _reduce(pending, ring_res)
+        reds, new_res = [], []
+        for b in range(B):
+            red_b, r_b = _reduce(pending[b],
+                                 ring_res[b] if ef else None, b)
+            reds.append(red_b)
+            new_res.append(r_b)
+        return jnp.concatenate(reds), new_res
 
     def local_step(state, batch):
         from ..utils import pytree as pt
@@ -573,7 +971,12 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
             raise ValueError(f"local batch {batch.shape[0]} not divisible "
                              f"by overlap_microbatches={M}")
         params = state.params
-        ring_res = (state.ring_residual[0] if ef else None)
+        if not ef:
+            ring_res = None
+        elif bm is None:
+            ring_res = state.ring_residual[0]
+        else:
+            ring_res = [r[0] for r in state.ring_residual]
         micro = batch.reshape((M, -1) + batch.shape[1:])
         acc = jnp.zeros((local,), jnp.float32)
         loss_sum = jnp.zeros((), jnp.float32)
@@ -592,11 +995,12 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
             if pending is not None:
                 # Microbatch m−1's ring rides alongside microbatch m's
                 # grad compute (the lines above): independent dataflow.
-                red, ring_res = _reduce(pending, ring_res)
+                red, ring_res = _reduce_all(pending, ring_res)
                 acc = acc + red
-            pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
-                              (0, pad))
-        red, ring_res = _reduce(pending, ring_res)
+            pending = (_bucket_vectors(bm, g) if bm is not None else
+                       jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
+                               (0, pad)))
+        red, ring_res = _reduce_all(pending, ring_res)
         acc = acc + red
         g_mine = acc / (n * M)      # mean over shards and microbatches
         loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
@@ -608,7 +1012,15 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                               scale=comm_scale)
 
         raw_flat, unravel = pt.flatten(params)
-        flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+        if bm is None:
+            flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+            pvecs = None
+        else:
+            # Bucketed: the param-side flat views are per-bucket too, so
+            # the owned slice is the concat of per-bucket chunks — the
+            # same coordinate order the per-bucket rings reduce into.
+            flat_p = None
+            pvecs = _bucket_vectors(bm, params)
         gather_res = None
         if aggregation == "zero1":
             if hier:
@@ -616,17 +1028,40 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                 shard = hier_slice_index(D)
             else:
                 shard = lax.axis_index("data")
-            p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
-            new_p_mine, opt_state = apply_optimizer(
-                optimizer, g_mine, state.opt_state, p_mine)
+            if bm is None:
+                p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local,
+                                                  local)
+                new_p_mine, opt_state = apply_optimizer(
+                    optimizer, g_mine, state.opt_state, p_mine)
+            else:
+                # One optimizer apply per bucket against the per-bucket
+                # moment state; elementwise updates make the concat
+                # value-identical to the single-slice apply.
+                p_chunks = [lax.dynamic_slice_in_dim(
+                    pvecs[b], shard * bm.sizes[b], bm.sizes[b])
+                    for b in range(B)]
+                new_chunks, opts = [], []
+                for b in range(B):
+                    np_b, opt_b = apply_optimizer(
+                        optimizer,
+                        g_mine[bm.offsets[b]:bm.offsets[b] + bm.sizes[b]],
+                        state.opt_state[b], p_chunks[b])
+                    new_chunks.append(np_b)
+                    opts.append(opt_b)
+                p_mine = jnp.concatenate(p_chunks)
+                new_p_mine = jnp.concatenate(new_chunks)
+                opt_state = tuple(opts)
+            vec_new = None
             if hier:
                 # Two-level broadcast, DCN leg first: islands exchange
                 # their superchunk's D slices (compressed when the DCN
                 # wire says so), then the island gathers S superchunks
                 # over ICI in fp32 — params stay exact on the fast tier.
                 if wire_dcn == "int8_ef":
+                    gres = (jnp.concatenate(state.gather_residual)
+                            if bm is not None else state.gather_residual)
                     q, s, gather_res = _int8_encode(
-                        (new_p_mine - p_mine) + state.gather_residual)
+                        (new_p_mine - p_mine) + gres)
                     q_all = comm.all_gather(
                         q, "dcn", tiled=True,
                         label="overlap_delta_gather_int8",
@@ -635,11 +1070,22 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                         s[None], "dcn", tiled=True,
                         label="overlap_delta_scale_gather",
                         scale=comm_scale)
-                    p_super = lax.dynamic_slice_in_dim(
-                        flat_p, lax.axis_index("data") * (D * local),
-                        D * local)
-                    super_new = p_super + (jnp.repeat(s_all, local)
-                                           * q_all.astype(jnp.float32))
+                    if bm is None:
+                        p_super = lax.dynamic_slice_in_dim(
+                            flat_p, lax.axis_index("data") * (D * local),
+                            D * local)
+                        super_new = p_super + (jnp.repeat(s_all, local)
+                                               * q_all.astype(jnp.float32))
+                    else:
+                        q_slc = _bucket_slices(bm,
+                                               q_all.astype(jnp.float32))
+                        super_new = jnp.concatenate([
+                            lax.dynamic_slice_in_dim(
+                                pvecs[b],
+                                lax.axis_index("data") * (D * bm.sizes[b]),
+                                D * bm.sizes[b])
+                            + jnp.repeat(s_all, bm.sizes[b]) * q_slc[b]
+                            for b in range(B)])
                 else:
                     super_new = comm.all_gather(
                         new_p_mine, "dcn", tiled=True,
@@ -648,6 +1094,13 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                 flat_new = comm.all_gather(
                     super_new, "data", tiled=True,
                     label="overlap_param_gather_ici", scale=comm_scale)
+                if bm is not None:
+                    # int8 DCN builds per-rank superchunk CONCATS (lead=D
+                    # blocks); the fp32/bf16 two-leg gather stacks plain
+                    # [local] slots in s·D + d order (lead=1).
+                    vec_new = _bucket_slices(
+                        bm, flat_new,
+                        lead=(D if wire_dcn == "int8_ef" else 1))
             elif wire == "int8_ef":
                 # Compressed second leg: broadcast the param DELTA int8
                 # (one byte/element + one scale/shard) with its own EF
@@ -655,26 +1108,42 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                 # applies the same dequantized deltas, so replicas stay
                 # bitwise identical; the fp32 moments stay exact; the
                 # quantization drift is compensated next step.
+                gres = (jnp.concatenate(state.gather_residual)
+                        if bm is not None else state.gather_residual)
                 q, s, gather_res = _int8_encode(
-                    (new_p_mine - p_mine) + state.gather_residual)
+                    (new_p_mine - p_mine) + gres)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="overlap_delta_gather_int8",
                                         scale=comm_scale)
                 s_all = comm.all_gather(s[None], "data", tiled=True,
                                         label="overlap_delta_scale_gather",
                                         scale=comm_scale)
-                flat_new = flat_p + (jnp.repeat(s_all, local)
-                                     * q_all.astype(jnp.float32))
+                if bm is None:
+                    flat_new = flat_p + (jnp.repeat(s_all, local)
+                                         * q_all.astype(jnp.float32))
+                else:
+                    q_slc = _bucket_slices(bm, q_all.astype(jnp.float32))
+                    vec_new = [pvecs[b]
+                               + jnp.repeat(s_all, bm.sizes[b]) * q_slc[b]
+                               for b in range(B)]
             else:
                 flat_new = comm.all_gather(new_p_mine, "data", tiled=True,
                                            label="overlap_param_gather",
                                            scale=comm_scale)
-            new_params = unravel(flat_new[:total].astype(raw_flat.dtype))
+                if bm is not None:
+                    vec_new = _bucket_slices(bm, flat_new)
+            if bm is None:
+                new_params = unravel(
+                    flat_new[:total].astype(raw_flat.dtype))
+            else:
+                new_params = _scatter_buckets(bm, vec_new, params)
         else:                       # replicated update
+            gres = (jnp.concatenate(state.gather_residual)
+                    if ef and bm is not None else state.gather_residual
+                    if ef else None)
             if hier:
                 if wire_dcn == "int8_ef":
-                    q, s, gather_res = _int8_encode(
-                        g_mine + state.gather_residual)
+                    q, s, gather_res = _int8_encode(g_mine + gres)
                     q_all = comm.all_gather(
                         q, "dcn", tiled=True,
                         label="overlap_grad_gather_int8",
@@ -706,8 +1175,7 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                         label="overlap_grad_gather_ici",
                         scale=comm_scale)
             elif wire == "int8_ef":
-                q, s, gather_res = _int8_encode(
-                    g_mine + state.gather_residual)
+                q, s, gather_res = _int8_encode(g_mine + gres)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="overlap_grad_gather_int8",
                                         scale=comm_scale)
@@ -725,7 +1193,13 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                 flat_g = comm.all_gather(g_mine, "data", tiled=True,
                                          label="overlap_grad_gather",
                                          scale=comm_scale)
-            grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            if bm is None:
+                grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            else:
+                # Every gathered stack in this branch is rank-major
+                # [ranks, local] in ownership order — lead=1 extraction.
+                grads = _scatter_buckets(bm, _bucket_slices(bm, flat_g),
+                                         params)
             new_params, opt_state = apply_optimizer(
                 optimizer, grads, state.opt_state, params)
         summary = None
@@ -739,8 +1213,17 @@ def _make_overlap_local_step(loss_fn: Callable, optimizer, n: int, pad: int,
                 params, jax.tree.map(lambda x: x / M, gacc), new_params)
         step = state.step + 1
         if ef:
+            if bm is not None:
+                # Per-bucket storage: each bucket's stack is a contiguous
+                # ordered-coordinate range (the reshard_state contract).
+                ring_res = tuple(r[None] for r in ring_res)
+                gather_res = tuple(
+                    gather_res[bm.offsets[b]:bm.offsets[b] + bm.sizes[b]]
+                    for b in range(B))
+            else:
+                ring_res = ring_res[None]
             new_state = OverlapEFState(new_params, opt_state, step,
-                                       ring_res[None], gather_res)
+                                       ring_res, gather_res)
         else:
             new_state = TrainState(new_params, opt_state, step)
         if guard_nonfinite:
@@ -774,6 +1257,7 @@ def make_overlap_step(loss_fn: Callable,
                       optimizer: optax.GradientTransformation,
                       mesh: Mesh, params, *, microbatches: int = 1,
                       wire="fp32", aggregation: str = "gradient",
+                      comm_buckets: int = 1,
                       guard_nonfinite: bool = False, numerics=None):
     """Per-step overlapped+compressed gradient-sync driver: ``step(state,
     batch) -> (state, loss)`` over a ``[B, T]`` batch sharded over the
@@ -787,15 +1271,20 @@ def make_overlap_step(loss_fn: Callable,
     "fp32"|"bf16"|"int8_ef"}`` runs the TWO-LEVEL reduction on a
     hierarchical mesh (``hier_data_mesh``): full-precision reduce-scatter
     within each ICI island, the compressed exchange across the DCN axis
-    only, then the intra-island gather. ``guard_nonfinite`` fuses the
+    only, then the intra-island gather. ``comm_buckets > 1`` turns on the
+    bucketed backward — per-bucket ring dispatch in VJP emission order,
+    so the first hop starts before the full gradient materializes (the
+    semantics and invariants in ``_make_overlap_local_step``; structural
+    proof via ``ring_overlap_evidence``). ``guard_nonfinite`` fuses the
     psum-agreed in-jit skip; ``numerics`` turns on the in-jit run-health
-    summary. Semantics in ``_make_overlap_local_step``."""
-    state, specs, dpart, n, pad, local, total, hier_shape = _overlap_setup(
-        mesh, params, optimizer, wire, aggregation)
+    summary."""
+    (state, specs, dpart, n, pad, local, total, hier_shape,
+     bm) = _overlap_setup(mesh, params, optimizer, wire, aggregation,
+                          comm_buckets)
     local_step = _make_overlap_local_step(
         loss_fn, optimizer, n, pad, local, total, microbatches=microbatches,
         wire=wire, aggregation=aggregation, hier_shape=hier_shape,
-        guard_nonfinite=guard_nonfinite, numerics=numerics)
+        bucket_map=bm, guard_nonfinite=guard_nonfinite, numerics=numerics)
     sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, P(dpart)), out_specs=(specs, P()),
@@ -807,6 +1296,7 @@ def make_overlap_multi_step(loss_fn: Callable,
                             optimizer: optax.GradientTransformation,
                             mesh: Mesh, params, *, microbatches: int = 1,
                             wire="fp32", aggregation: str = "gradient",
+                            comm_buckets: int = 1,
                             guard_nonfinite: bool = False, numerics=None):
     """The overlapped+compressed driver inside the K-step scan:
     ``step(state, window) -> (state, losses)`` with ``window`` a
@@ -820,16 +1310,21 @@ def make_overlap_multi_step(loss_fn: Callable,
     ``make_overlap_step`` for the two-level hierarchical path, and
     ``guard_nonfinite``/``numerics`` ride the scanned body unchanged (the
     numerics summary comes back stacked [K], exactly like
-    ``dp.make_multi_step``'s)."""
-    state, specs, dpart, n, pad, local, total, hier_shape = _overlap_setup(
-        mesh, params, optimizer, wire, aggregation)
+    ``dp.make_multi_step``'s). ``comm_buckets`` composes: the per-bucket
+    EF residual tuples ride the scan carry like the legacy arrays, so
+    K-scanned bucketed dispatch stays bitwise-equal to K per-step calls
+    at any K, M and bucket count."""
+    (state, specs, dpart, n, pad, local, total, hier_shape,
+     bm) = _overlap_setup(mesh, params, optimizer, wire, aggregation,
+                          comm_buckets)
 
     def multi(state, window):
         local_step = _make_overlap_local_step(
             loss_fn, optimizer, n, pad, local, total,
             microbatches=microbatches, wire=wire, aggregation=aggregation,
             comm_scale=window.shape[0], hier_shape=hier_shape,
-            guard_nonfinite=guard_nonfinite, numerics=numerics)
+            bucket_map=bm, guard_nonfinite=guard_nonfinite,
+            numerics=numerics)
         return lax.scan(local_step, state, window)
 
     sharded = shard_map(

@@ -586,6 +586,20 @@ def reshard_state(host_state, template_state):
     row-wise before the leaf pass. That is what lets elastic mode compose
     with compressed wire (ROADMAP 7c).
 
+    Bucketed snapshots (``comm_buckets > 1``: both EF residual fields are
+    per-bucket TUPLES) reshard bucket-by-bucket. Bucket counts must match
+    between snapshot and template (rebucketing a live EF state is
+    undefined — the residuals are per-coordinate pending corrections in
+    bucket coordinate order). Every bucket except the last covers a FIXED
+    span of flat coordinates (the global pad rides the last bucket), so a
+    world resize is representable only when the new ``(world, buckets)``
+    pair reproduces the interior bucket spans; otherwise the named
+    "indivisible bucket×shard factorization" error fires — resize through
+    ``comm_buckets=1``, or pick a divisible pair. Interior-span-preserving
+    resizes run ``_resize_ring_residual`` per bucket (rows re-chunk, last
+    bucket pad-swaps) and the per-bucket 1-D gather residuals fall through
+    to the flat-vector leaf rule.
+
     Value-exact by construction: every surviving coordinate is a bitwise
     copy, so a trajectory continued from the resharded state is the
     trajectory of a fresh M-way run initialized from the same snapshot
@@ -594,10 +608,36 @@ def reshard_state(host_state, template_state):
 
     if (hasattr(host_state, "ring_residual")
             and hasattr(template_state, "ring_residual")):
-        host_state = host_state._replace(
-            ring_residual=_resize_ring_residual(
-                np.asarray(host_state.ring_residual),
-                tuple(template_state.ring_residual.shape)))
+        h_rr = host_state.ring_residual
+        t_rr = template_state.ring_residual
+        h_tup, t_tup = isinstance(h_rr, tuple), isinstance(t_rr, tuple)
+        if h_tup != t_tup or (h_tup and len(h_rr) != len(t_rr)):
+            raise ValueError(
+                f"comm_buckets mismatch: the snapshot carries "
+                f"{len(h_rr) if h_tup else 1} EF residual bucket(s), the "
+                f"template {len(t_rr) if t_tup else 1} — rebucketing a "
+                f"live EF state is not defined; rebuild the trainer with "
+                f"the snapshot's comm_buckets")
+        if h_tup:
+            for b, (h, t) in enumerate(zip(h_rr[:-1], t_rr[:-1])):
+                if int(np.asarray(h).shape[-1]) != int(t.shape[-1]):
+                    raise ValueError(
+                        f"indivisible bucket×shard factorization: "
+                        f"interior bucket {b} covers "
+                        f"{int(np.asarray(h).shape[-1])} coordinates in "
+                        f"the snapshot but {int(t.shape[-1])} in the "
+                        f"template — bucket boundaries move with the data "
+                        f"world unless the per-shard slice divides "
+                        f"evenly; resize via comm_buckets=1 or choose a "
+                        f"(world, comm_buckets) pair that preserves the "
+                        f"interior bucket spans")
+            host_state = host_state._replace(ring_residual=tuple(
+                _resize_ring_residual(np.asarray(h), tuple(t.shape))
+                for h, t in zip(h_rr, t_rr)))
+        else:
+            host_state = host_state._replace(
+                ring_residual=_resize_ring_residual(
+                    np.asarray(h_rr), tuple(t_rr.shape)))
 
     def leaf(h, t):
         if not isinstance(t, jax.Array):

@@ -799,8 +799,40 @@ def _pp_flat_geometry(mesh: Mesh, params):
     return n, pad, local, total
 
 
+def _pp_bucket_map(mesh: Mesh, params, comm_buckets: int):
+    """The DP×PP ``BucketMap``: ``compress.make_bucket_map`` over the
+    PER-CELL leaf geometry — each (stage[, model]) cell's local tree
+    (stage block slices of [L/S] layers, col/row leaves at 1/tp, the
+    stage-replicated embed/head/final-norm in full), which is the tree
+    the shard_map body actually flattens. Returns None at
+    ``comm_buckets == 1`` (the legacy single-vector path)."""
+    from .compress import make_bucket_map
+
+    if int(comm_buckets) < 1:
+        raise ValueError(
+            f"comm_buckets must be >= 1 (got {comm_buckets})")
+    if int(comm_buckets) == 1:
+        return None
+    n = mesh.shape.get("data", 1)
+    n_stages = mesh.shape["stage"]
+    tp = mesh.shape.get("model", 1)
+
+    def leaf_local(path, leaf):
+        key = getattr(path[0], "key", None) if path else None
+        if key == "blocks":
+            name = getattr(path[1], "key", None) if len(path) > 1 else None
+            size = int(leaf.size) // n_stages
+            if name in _TP_COL or name in _TP_ROW:
+                size //= tp
+            return size, int(leaf.shape[0]) // n_stages
+        return int(leaf.size), None
+
+    return make_bucket_map(params, n, comm_buckets, leaf_local=leaf_local)
+
+
 def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
-                      aggregation: str, schedule: str, n_chunks: int):
+                      aggregation: str, schedule: str, n_chunks: int,
+                      comm_buckets: int = 1):
     """State + shard specs + flat geometry for the DP×PP overlap drivers.
 
     ZeRO-1 moments live as ``[n_data, n_stages, local]`` global arrays
@@ -818,7 +850,12 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
     "model")`` — each (d, s, m) shard rings its OWN per-model-shard flat
     slice over ``data``, so the rings on different model coordinates are
     independent. The tp == 1 layouts stay byte-identical to the classic
-    DP×PP ones (checkpoint compatibility)."""
+    DP×PP ones (checkpoint compatibility).
+
+    ``comm_buckets > 1`` (the bucketed backward, ``compress.BucketMap``
+    over the PER-CELL geometry — ``_pp_bucket_map``) turns the ZeRO-1
+    moments and both EF residuals into per-bucket tuples, mirroring the
+    DP driver's layout rule with the (stage[, model]) shard axes kept."""
     if aggregation not in ("gradient", "zero1"):
         raise ValueError("the DP×PP overlap driver supports gradient/zero1 "
                          f"aggregation only (got {aggregation!r})")
@@ -843,29 +880,46 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
               else P("data", "stage"))
     _check_layout(params.get(_LAYOUT_KEY), schedule, n_stages, n_chunks)
     n, pad, local, total = _pp_flat_geometry(mesh, params)
+    bm = _pp_bucket_map(mesh, params, comm_buckets)
     specs = param_specs(params, tp=tp > 1)
     sharded = shard_params(mesh, params)
     step0 = jax.device_put(jnp.zeros((), jnp.int32),
                            NamedSharding(mesh, P()))
     if aggregation == "zero1":
-        abstract_opt = jax.eval_shape(
-            optimizer.init, jax.ShapeDtypeStruct((local,), jnp.float32))
-        opt_specs = jax.tree.map(
-            lambda x: dshard if getattr(x, "ndim", 0) >= 1 else P(),
-            abstract_opt)
+        sizes = bm.sizes if bm is not None else (local,)
+
+        def _specs_for(sz):
+            abstract = jax.eval_shape(
+                optimizer.init, jax.ShapeDtypeStruct((sz,), jnp.float32))
+            return jax.tree.map(
+                lambda x: dshard if getattr(x, "ndim", 0) >= 1 else P(),
+                abstract)
+
+        opt_specs = (_specs_for(local) if bm is None
+                     else tuple(_specs_for(sz) for sz in sizes))
 
         def local_init(p):
             from ..utils import pytree as pt
-            flat = jnp.pad(pt.flatten(p)[0].astype(jnp.float32), (0, pad))
-            mine = lax.dynamic_slice_in_dim(
-                flat, lax.axis_index("data") * local, local)
-            opt = optimizer.init(mine)
+            from .compress import _bucket_vectors
+            shard = lax.axis_index("data")
+            if bm is None:
+                flat = jnp.pad(pt.flatten(p)[0].astype(jnp.float32),
+                               (0, pad))
+                mine = [lax.dynamic_slice_in_dim(flat, shard * local,
+                                                 local)]
+            else:
+                vecs = _bucket_vectors(bm, p)
+                mine = [lax.dynamic_slice_in_dim(
+                    vecs[b], shard * bm.sizes[b], bm.sizes[b])
+                    for b in range(bm.nbuckets)]
             # Vector leaves gain the (data, stage[, model]) shard axes;
             # scalars (count) replicate — every shard steps them
             # identically.
-            return jax.tree.map(
+            opts = [jax.tree.map(
                 lambda x: (x[(None,) * lead]
-                           if getattr(x, "ndim", 0) >= 1 else x), opt)
+                           if getattr(x, "ndim", 0) >= 1 else x),
+                optimizer.init(m)) for m in mine]
+            return opts[0] if bm is None else tuple(opts)
 
         opt_state = jax.jit(shard_map(
             local_init, mesh=mesh, in_specs=(specs,),
@@ -878,18 +932,28 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
     if wire == "int8_ef":
         from .compress import OverlapEFState
         mid = (n_stages, tp) if tp > 1 else (n_stages,)
-        ring_res = jax.device_put(
-            jnp.zeros((n,) + mid + (n * local,), jnp.float32),
-            NamedSharding(mesh, dshard))
-        gather_res = jax.device_put(
-            jnp.zeros((n,) + mid + (local,), jnp.float32),
-            NamedSharding(mesh, dshard))
+
+        def _zeros(shape):
+            return jax.device_put(jnp.zeros(shape, jnp.float32),
+                                  NamedSharding(mesh, dshard))
+
+        if bm is None:
+            ring_res = _zeros((n,) + mid + (n * local,))
+            gather_res = _zeros((n,) + mid + (local,))
+            ring_specs = gather_specs = dshard
+        else:
+            ring_res = tuple(_zeros((n,) + mid + (n * sz,))
+                             for sz in bm.sizes)
+            gather_res = tuple(_zeros((n,) + mid + (sz,))
+                               for sz in bm.sizes)
+            ring_specs = gather_specs = (dshard,) * bm.nbuckets
         state = OverlapEFState(state.params, state.opt_state, state.step,
                                ring_res, gather_res)
-        state_specs = OverlapEFState(specs, opt_specs, P(), dshard, dshard)
+        state_specs = OverlapEFState(specs, opt_specs, P(), ring_specs,
+                                     gather_specs)
     else:
         state_specs = TrainState(specs, opt_specs, P())
-    return state, state_specs, n, pad, local, total
+    return state, state_specs, n, pad, local, total, bm
 
 
 def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
@@ -897,6 +961,7 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
                                 tp: int, n: int, pad: int, local: int,
                                 total: int, microbatches: int, wire: str,
                                 aggregation: str, comm_scale: int = 1,
+                                bucket_map=None,
                                 numerics=None) -> Callable:
     """The per-shard DP×PP overlapped step body shared by
     ``make_pipeline_overlap_step`` and ``make_pipeline_overlap_multi_step``.
@@ -922,11 +987,21 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
     then-accumulate vs the pmean path's accumulate-then-reduce), so
     equivalence vs ``make_pipeline_step`` is fp32-tolerance; M=1 fp32
     differs only by ring-vs-XLA reduction order. The interleaved layout
-    tag re-pins exactly after the flat update round-trip."""
+    tag re-pins exactly after the flat update round-trip.
+
+    ``bucket_map`` (``compress.BucketMap`` over the per-cell geometry,
+    None for the legacy single-vector path) selects the bucketed
+    backward: per-bucket rings in VJP emission order under
+    ``pp_ring_grad_b{b}`` labels, single-collective gather legs, and
+    per-bucket moment/residual tuples — the DP driver's rules
+    (``compress._make_overlap_local_step``) under the pipeline."""
     from ..utils import pytree as pt
-    from .compress import _int8_encode, ring_reduce_scatter
+    from .compress import (_bucket_slices, _bucket_vectors, _int8_encode,
+                           _scatter_buckets, ring_reduce_scatter)
 
     M = microbatches
+    bm = bucket_map
+    B = bm.nbuckets if bm is not None else 1
     ef = wire == "int8_ef"
     # Leading shard axes wrapping the per-shard [local] state views:
     # (data, stage) classically, (data, stage, model) on a DP×PP×TP mesh
@@ -943,13 +1018,38 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
     # tests/test_pp.py.
     ssync = ("stage", "model") if tp > 1 else ("stage",)
 
+    def _ring_all(pending, ring_res):
+        # pending: the flat vector (bm None) or the per-bucket vector
+        # list; ring_res mirrors it. Returns the owned [local] slice
+        # (concat of per-bucket chunks when bucketed).
+        if bm is None:
+            return ring_reduce_scatter(
+                pending, "data", wire=wire, residual=ring_res,
+                label="pp_ring_grad", comm_scale=comm_scale,
+                scale_sync_axis=ssync)
+        reds, news = [], []
+        for b in range(B):
+            red_b, r_b = ring_reduce_scatter(
+                pending[b], "data", wire=wire,
+                residual=ring_res[b] if ef else None,
+                label=f"pp_ring_grad_b{b}", comm_scale=comm_scale,
+                scale_sync_axis=ssync)
+            reds.append(red_b)
+            news.append(r_b)
+        return jnp.concatenate(reds), news
+
     def local_step(state, tokens):
         params = state.params
         if tokens.shape[0] % M:
             raise ValueError(f"local batch {tokens.shape[0]} not divisible "
                              f"by overlap_microbatches={M}")
         micro = tokens.reshape((M, -1) + tokens.shape[1:])
-        ring_res = state.ring_residual[(0,) * lead] if ef else None
+        if not ef:
+            ring_res = None
+        elif bm is None:
+            ring_res = state.ring_residual[(0,) * lead]
+        else:
+            ring_res = [r[(0,) * lead] for r in state.ring_residual]
         acc = jnp.zeros((local,), jnp.float32)
         loss_sum = jnp.zeros((), jnp.float32)
         gacc = None
@@ -968,46 +1068,78 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
             if pending is not None:
                 # Microbatch m−1's ring rides alongside microbatch m's
                 # schedule (the body call above): independent dataflow.
-                red, ring_res = ring_reduce_scatter(
-                    pending, "data", wire=wire, residual=ring_res,
-                    label="pp_ring_grad", comm_scale=comm_scale,
-                    scale_sync_axis=ssync)
+                red, ring_res = _ring_all(pending, ring_res)
                 acc = acc + red
-            pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
-                              (0, pad))
-        red, ring_res = ring_reduce_scatter(
-            pending, "data", wire=wire, residual=ring_res,
-            label="pp_ring_grad", comm_scale=comm_scale,
-            scale_sync_axis=ssync)
+            pending = (_bucket_vectors(bm, g) if bm is not None else
+                       jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
+                               (0, pad)))
+        red, ring_res = _ring_all(pending, ring_res)
         acc = acc + red
         g_mine = acc / (n * M)      # mean over data shards and microbatches
         loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
                           scale=comm_scale)
 
         raw_flat, unravel = pt.flatten(params)
-        flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+        if bm is None:
+            flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+            pvecs = None
+        else:
+            flat_p = None
+            pvecs = _bucket_vectors(bm, params)
         gather_res = None
         shard = lax.axis_index("data")
         if aggregation == "zero1":
-            p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
-            # Local moment view: (data, stage[, model])-sharded vector
-            # leaves squeeze to the flat slice; scalars pass.
-            opt_local = jax.tree.map(
-                lambda x: (x[(0,) * lead]
-                           if getattr(x, "ndim", 0) >= lead + 1 else x),
-                state.opt_state)
-            new_p_mine, opt_local = apply_optimizer(optimizer, g_mine,
-                                                    opt_local, p_mine)
-            opt_state = jax.tree.map(
-                lambda x: (x[(None,) * lead]
-                           if getattr(x, "ndim", 0) >= 1 else x), opt_local)
+            if bm is None:
+                p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local,
+                                                  local)
+                # Local moment view: (data, stage[, model])-sharded vector
+                # leaves squeeze to the flat slice; scalars pass.
+                opt_local = jax.tree.map(
+                    lambda x: (x[(0,) * lead]
+                               if getattr(x, "ndim", 0) >= lead + 1 else x),
+                    state.opt_state)
+                new_p_mine, opt_local = apply_optimizer(optimizer, g_mine,
+                                                        opt_local, p_mine)
+                opt_state = jax.tree.map(
+                    lambda x: (x[(None,) * lead]
+                               if getattr(x, "ndim", 0) >= 1 else x),
+                    opt_local)
+            else:
+                # One optimizer apply per bucket against the per-bucket
+                # moment tuple (layout rule in _pp_overlap_setup).
+                p_chunks = [lax.dynamic_slice_in_dim(
+                    pvecs[b], shard * bm.sizes[b], bm.sizes[b])
+                    for b in range(B)]
+                new_chunks, opts = [], []
+                for b in range(B):
+                    opt_local = jax.tree.map(
+                        lambda x: (x[(0,) * lead]
+                                   if getattr(x, "ndim", 0) >= lead + 1
+                                   else x),
+                        state.opt_state[b])
+                    np_b, opt_local = apply_optimizer(
+                        optimizer,
+                        g_mine[bm.offsets[b]:bm.offsets[b] + bm.sizes[b]],
+                        opt_local, p_chunks[b])
+                    new_chunks.append(np_b)
+                    opts.append(jax.tree.map(
+                        lambda x: (x[(None,) * lead]
+                                   if getattr(x, "ndim", 0) >= 1 else x),
+                        opt_local))
+                p_mine = jnp.concatenate(p_chunks)
+                new_p_mine = jnp.concatenate(new_chunks)
+                opt_state = tuple(opts)
+            vec_new = None
             if wire == "int8_ef":
                 # Compressed second leg: broadcast the param DELTA int8
                 # with its own EF residual (the compress.py zero1 rule —
                 # fp32 moments stay exact, replicas stay bitwise in sync).
+                gres = (jnp.concatenate(
+                    [r[(0,) * lead] for r in state.gather_residual])
+                    if bm is not None
+                    else state.gather_residual[(0,) * lead])
                 q, s, gather_res = _int8_encode(
-                    (new_p_mine - p_mine)
-                    + state.gather_residual[(0,) * lead],
+                    (new_p_mine - p_mine) + gres,
                     scale_sync_axis=ssync)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="pp_delta_gather_int8",
@@ -1015,20 +1147,35 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
                 s_all = comm.all_gather(s[None], "data", tiled=True,
                                         label="pp_delta_scale_gather",
                                         scale=comm_scale)
-                flat_new = flat_p + (jnp.repeat(s_all, local)
-                                     * q_all.astype(jnp.float32))
+                if bm is None:
+                    flat_new = flat_p + (jnp.repeat(s_all, local)
+                                         * q_all.astype(jnp.float32))
+                else:
+                    q_slc = _bucket_slices(bm, q_all.astype(jnp.float32))
+                    vec_new = [pvecs[b]
+                               + jnp.repeat(s_all, bm.sizes[b]) * q_slc[b]
+                               for b in range(B)]
             else:
                 # bf16 wire compresses the RING leg only — the param
                 # gather stays fp32 (params stay exact, compress.py rule).
                 flat_new = comm.all_gather(new_p_mine, "data", tiled=True,
                                            label="pp_param_gather",
                                            scale=comm_scale)
-            new_params = unravel(flat_new[:total].astype(raw_flat.dtype))
+                if bm is not None:
+                    vec_new = _bucket_slices(bm, flat_new)
+            if bm is None:
+                new_params = unravel(
+                    flat_new[:total].astype(raw_flat.dtype))
+            else:
+                new_params = _scatter_buckets(bm, vec_new, params)
         else:                       # replicated gradient update
             if wire == "int8_ef":
+                gres = (jnp.concatenate(
+                    [r[(0,) * lead] for r in state.gather_residual])
+                    if bm is not None
+                    else state.gather_residual[(0,) * lead])
                 q, s, gather_res = _int8_encode(
-                    g_mine + state.gather_residual[(0,) * lead],
-                    scale_sync_axis=ssync)
+                    g_mine + gres, scale_sync_axis=ssync)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="pp_grad_gather_int8",
                                         scale=comm_scale)
@@ -1046,7 +1193,11 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
                 flat_g = comm.all_gather(g_mine, "data", tiled=True,
                                          label="pp_grad_gather",
                                          scale=comm_scale)
-            grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            if bm is None:
+                grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            else:
+                grads = _scatter_buckets(bm, _bucket_slices(bm, flat_g),
+                                         params)
             new_params, opt_state = apply_optimizer(optimizer, grads,
                                                     state.opt_state, params)
         if _LAYOUT_KEY in new_params:
@@ -1055,9 +1206,17 @@ def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,
         step = state.step + 1
         if ef:
             from .compress import OverlapEFState
+            if bm is not None:
+                ring_out = tuple(r[(None,) * lead] for r in ring_res)
+                gather_out = tuple(
+                    gather_res[bm.offsets[b]:bm.offsets[b] + bm.sizes[b]]
+                    [(None,) * lead]
+                    for b in range(B))
+            else:
+                ring_out = ring_res[(None,) * lead]
+                gather_out = gather_res[(None,) * lead]
             new_state = OverlapEFState(new_params, opt_state, step,
-                                       ring_res[(None,) * lead],
-                                       gather_res[(None,) * lead])
+                                       ring_out, gather_out)
         else:
             new_state = TrainState(new_params, opt_state, step)
         if numerics is not None:
@@ -1077,26 +1236,29 @@ def make_pipeline_overlap_step(cfg: LlamaConfig,
                                aggregation: str = "zero1",
                                wire: str = "fp32",
                                overlap_microbatches: int = 1,
+                               comm_buckets: int = 1,
                                numerics=None):
     """Per-step DP×PP composition driver: ``step(state, tokens) -> (state,
     loss)`` over a ``[n_data·B, T]`` batch sharded over ``data``, with the
     data-axis gradient sync routed through the compressed/overlapped ring
-    (semantics in ``_make_pp_overlap_local_step``). Returns ``(state,
+    (semantics in ``_make_pp_overlap_local_step``; ``comm_buckets > 1``
+    selects the bucketed backward). Returns ``(state,
     step_fn)`` — an ``OverlapEFState`` under ``wire="int8_ef"`` (EF
     residuals in the checkpointed tree, per (data, stage) shard), a plain
     TrainState otherwise, with ZeRO-1 moments sharded over
     ``(data, stage)`` when ``aggregation="zero1"``."""
     n_stages = mesh.shape["stage"]
     body = _schedule_body(schedule, n_chunks)
-    state, state_specs, n, pad, local, total = _pp_overlap_setup(
-        optimizer, mesh, params, wire, aggregation, schedule, n_chunks)
+    state, state_specs, n, pad, local, total, bm = _pp_overlap_setup(
+        optimizer, mesh, params, wire, aggregation, schedule, n_chunks,
+        comm_buckets)
     has_data = mesh.shape.get("data", 1) > 1
     local_step = _make_pp_overlap_local_step(
         cfg, optimizer, body, n_stages=n_stages,
         n_microbatches=n_microbatches, tp=mesh.shape.get("model", 1), n=n,
         pad=pad, local=local, total=total,
         microbatches=overlap_microbatches, wire=wire,
-        aggregation=aggregation, numerics=numerics)
+        aggregation=aggregation, bucket_map=bm, numerics=numerics)
     out_specs = (state_specs,
                  ((P(), numerics.summary_specs()) if numerics is not None
                   else P()))
@@ -1116,6 +1278,7 @@ def make_pipeline_overlap_multi_step(cfg: LlamaConfig,
                                      aggregation: str = "zero1",
                                      wire: str = "fp32",
                                      overlap_microbatches: int = 1,
+                                     comm_buckets: int = 1,
                                      numerics=None):
     """The DP×PP composition driver inside the K-step scan: ``step(state,
     window) -> (state, losses)`` with ``window`` a ``[K, n_data·B, T]``
@@ -1127,8 +1290,9 @@ def make_pipeline_overlap_multi_step(cfg: LlamaConfig,
     final state are bitwise-identical to K per-step calls at any K."""
     n_stages = mesh.shape["stage"]
     body = _schedule_body(schedule, n_chunks)
-    state, state_specs, n, pad, local, total = _pp_overlap_setup(
-        optimizer, mesh, params, wire, aggregation, schedule, n_chunks)
+    state, state_specs, n, pad, local, total, bm = _pp_overlap_setup(
+        optimizer, mesh, params, wire, aggregation, schedule, n_chunks,
+        comm_buckets)
     has_data = mesh.shape.get("data", 1) > 1
 
     def multi(st, window):
@@ -1138,7 +1302,7 @@ def make_pipeline_overlap_multi_step(cfg: LlamaConfig,
             n=n, pad=pad, local=local, total=total,
             microbatches=overlap_microbatches, wire=wire,
             aggregation=aggregation, comm_scale=window.shape[0],
-            numerics=numerics)
+            bucket_map=bm, numerics=numerics)
         return lax.scan(local_step, st, window)
 
     out_specs = (state_specs,

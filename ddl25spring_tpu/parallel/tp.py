@@ -700,14 +700,46 @@ def _tp_flat_geometry(mesh: Mesh, params):
     return n, pad, local, total
 
 
+def _tp_bucket_map(mesh: Mesh, params, comm_buckets: int):
+    """The DP×TP ``BucketMap``: ``compress.make_bucket_map`` over the
+    PER-MODEL-SHARD leaf geometry (col/row block leaves at 1/tp, full
+    stacked [L] layer depth) — the tree the shard_map body flattens.
+    Returns None at ``comm_buckets == 1`` (the legacy path)."""
+    from .compress import make_bucket_map
+
+    if int(comm_buckets) < 1:
+        raise ValueError(
+            f"comm_buckets must be >= 1 (got {comm_buckets})")
+    if int(comm_buckets) == 1:
+        return None
+    n = mesh.shape.get("data", 1)
+    tp = mesh.shape["model"]
+
+    def leaf_local(path, leaf):
+        key = getattr(path[0], "key", None) if path else None
+        if key == "blocks":
+            name = getattr(path[1], "key", None) if len(path) > 1 else None
+            size = int(leaf.size)
+            if name in _COL or name in _ROW:
+                size //= tp
+            return size, int(leaf.shape[0])
+        return int(leaf.size), None
+
+    return make_bucket_map(params, n, comm_buckets, leaf_local=leaf_local)
+
+
 def _tp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
-                      aggregation: str, psa: str, n_layers: int):
+                      aggregation: str, psa: str, n_layers: int,
+                      comm_buckets: int = 1):
     """State + shard specs + flat geometry for the DP×TP overlap drivers.
 
     ZeRO-1 moments live as ``[n_data, tp, local]`` global arrays sharded
     ``P("data", "model")`` — each (d, m) shard owns the moments of model
     shard m's d-th flat slice; int8 EF residuals get the same layout
-    (ring: ``[n, tp, n·local]``; gather: ``[n, tp, local]``)."""
+    (ring: ``[n, tp, n·local]``; gather: ``[n, tp, local]``).
+    ``comm_buckets > 1`` (the bucketed backward, ``_tp_bucket_map``)
+    turns moments and residuals into per-bucket tuples, mirroring the DP
+    driver's layout rule with the (data, model) shard axes kept."""
     mode, period = _parse_psa(psa, n_layers)
     if aggregation not in ("gradient", "zero1"):
         raise ValueError("the DP×TP overlap driver supports gradient/zero1 "
@@ -735,6 +767,7 @@ def _tp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
             "'defer:L'} with the ring, or psa='int8_ef' on the non-overlap "
             "TP factories (make_tp_step / make_tp_multi_step)")
     n, pad, local, total = _tp_flat_geometry(mesh, params)
+    bm = _tp_bucket_map(mesh, params, comm_buckets)
     specs = param_specs(params)
     sharded = shard_params(mesh, params)
     step0 = jax.device_put(jnp.zeros((), jnp.int32),
@@ -742,23 +775,37 @@ def _tp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
     tp = mesh.shape["model"]
     dshard = P("data", "model")
     if aggregation == "zero1":
-        abstract_opt = jax.eval_shape(
-            optimizer.init, jax.ShapeDtypeStruct((local,), jnp.float32))
-        opt_specs = jax.tree.map(
-            lambda x: dshard if getattr(x, "ndim", 0) >= 1 else P(),
-            abstract_opt)
+        def _specs_for(sz):
+            abstract = jax.eval_shape(
+                optimizer.init, jax.ShapeDtypeStruct((sz,), jnp.float32))
+            return jax.tree.map(
+                lambda x: dshard if getattr(x, "ndim", 0) >= 1 else P(),
+                abstract)
+
+        opt_specs = (_specs_for(local) if bm is None else
+                     tuple(_specs_for(sz) for sz in bm.sizes))
 
         def local_init(p):
             from ..utils import pytree as pt
-            flat = jnp.pad(pt.flatten(p)[0].astype(jnp.float32), (0, pad))
-            mine = lax.dynamic_slice_in_dim(
-                flat, lax.axis_index("data") * local, local)
-            opt = optimizer.init(mine)
+            from .compress import _bucket_vectors
+            if bm is None:
+                flat = jnp.pad(pt.flatten(p)[0].astype(jnp.float32),
+                               (0, pad))
+                mine = [lax.dynamic_slice_in_dim(
+                    flat, lax.axis_index("data") * local, local)]
+            else:
+                vecs = _bucket_vectors(bm, p)
+                mine = [lax.dynamic_slice_in_dim(
+                            vecs[b], lax.axis_index("data") * bm.sizes[b],
+                            bm.sizes[b])
+                        for b in range(bm.nbuckets)]
             # Vector leaves gain the (data, model) shard axes; scalars
             # (count) replicate — every shard steps them identically.
-            return jax.tree.map(
-                lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
-                           else x), opt)
+            opts = [jax.tree.map(
+                        lambda x: (x[None, None]
+                                   if getattr(x, "ndim", 0) >= 1 else x),
+                        optimizer.init(m)) for m in mine]
+            return opts[0] if bm is None else tuple(opts)
 
         opt_state = jax.jit(shard_map(
             local_init, mesh=mesh, in_specs=(specs,),
@@ -771,18 +818,26 @@ def _tp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
         state = TrainState(sharded, opt_state, step0)
     if wire == "int8_ef":
         from .compress import OverlapEFState
-        ring_res = jax.device_put(
-            jnp.zeros((n, tp, n * local), jnp.float32),
-            NamedSharding(mesh, dshard))
-        gather_res = jax.device_put(
-            jnp.zeros((n, tp, local), jnp.float32),
-            NamedSharding(mesh, dshard))
+
+        def _zeros(shape):
+            return jax.device_put(jnp.zeros(shape, jnp.float32),
+                                  NamedSharding(mesh, dshard))
+
+        if bm is None:
+            ring_res = _zeros((n, tp, n * local))
+            gather_res = _zeros((n, tp, local))
+            ring_specs = gather_specs = dshard
+        else:
+            ring_res = tuple(_zeros((n, tp, n * sz)) for sz in bm.sizes)
+            gather_res = tuple(_zeros((n, tp, sz)) for sz in bm.sizes)
+            ring_specs = gather_specs = (dshard,) * bm.nbuckets
         state = OverlapEFState(state.params, state.opt_state, state.step,
                                ring_res, gather_res)
-        state_specs = OverlapEFState(specs, opt_specs, P(), dshard, dshard)
+        state_specs = OverlapEFState(specs, opt_specs, P(),
+                                     ring_specs, gather_specs)
     else:
         state_specs = TrainState(specs, opt_specs, P())
-    return state, state_specs, n, pad, local, total, mode, period
+    return state, state_specs, n, pad, local, total, mode, period, bm
 
 
 def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
@@ -790,6 +845,7 @@ def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
                                 local: int, total: int, microbatches: int,
                                 wire: str, aggregation: str,
                                 comm_scale: int = 1,
+                                bucket_map=None,
                                 numerics=None) -> Callable:
     """The per-shard DP×TP overlapped step body shared by
     ``make_tp_overlap_step`` and ``make_tp_overlap_multi_step`` — the
@@ -806,11 +862,21 @@ def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
 
     Numerics contract mirrors the flat driver's: M>1 re-associates, so
     equivalence vs ``make_tp_step`` is fp32-tolerance; M=1 fp32 differs
-    only by ring-vs-XLA reduction order."""
+    only by ring-vs-XLA reduction order.
+
+    ``bucket_map`` (``_tp_bucket_map``, None for the legacy path) selects
+    the bucketed backward: per-bucket ring vectors under labels
+    ``tp_ring_grad_b{b}``, per-(data, model)-shard per-bucket EF/moment
+    tuples, gather legs kept as ONE collective — the compress.py bucketing
+    contract verbatim, with the model-agreed scale rule intact per
+    bucket."""
     from ..utils import pytree as pt
-    from .compress import _int8_encode, ring_reduce_scatter
+    from .compress import (_bucket_slices, _bucket_vectors, _int8_encode,
+                           _scatter_buckets, ring_reduce_scatter)
 
     M = microbatches
+    bm = bucket_map
+    B = bm.nbuckets if bm is not None else 1
     ef = wire == "int8_ef"
     # Model-agreed int8 scales (compress._int8_encode docstring): the flat
     # vector mixes model-cell-specific col/row shards with model-REPLICATED
@@ -819,13 +885,37 @@ def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
     # tests/test_tp.py's replica-sync and preempt/resume tests.
     ssync = "model" if tp > 1 else None
 
+    def _ring(pending, ring_res, bucket=None):
+        label = ("tp_ring_grad" if bucket is None
+                 else f"tp_ring_grad_b{bucket}")
+        return ring_reduce_scatter(pending, "data", wire=wire,
+                                   residual=ring_res, label=label,
+                                   comm_scale=comm_scale,
+                                   scale_sync_axis=ssync)
+
+    def _ring_all(pending, ring_res):
+        if bm is None:
+            return _ring(pending, ring_res)
+        reds, new_res = [], []
+        for b in range(B):
+            red_b, r_b = _ring(pending[b],
+                               ring_res[b] if ef else None, b)
+            reds.append(red_b)
+            new_res.append(r_b)
+        return jnp.concatenate(reds), new_res
+
     def local_step(state, tokens):
         params = state.params
         if tokens.shape[0] % M:
             raise ValueError(f"local batch {tokens.shape[0]} not divisible "
                              f"by overlap_microbatches={M}")
         micro = tokens.reshape((M, -1) + tokens.shape[1:])
-        ring_res = state.ring_residual[0, 0] if ef else None
+        if not ef:
+            ring_res = None
+        elif bm is None:
+            ring_res = state.ring_residual[0, 0]
+        else:
+            ring_res = [r[0, 0] for r in state.ring_residual]
         acc = jnp.zeros((local,), jnp.float32)
         loss_sum = jnp.zeros((), jnp.float32)
         gacc = None
@@ -849,45 +939,79 @@ def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
             if pending is not None:
                 # Microbatch m−1's ring rides alongside microbatch m's
                 # forward/backward (the call above): independent dataflow.
-                red, ring_res = ring_reduce_scatter(
-                    pending, "data", wire=wire, residual=ring_res,
-                    label="tp_ring_grad", comm_scale=comm_scale,
-                    scale_sync_axis=ssync)
+                red, ring_res = _ring_all(pending, ring_res)
                 acc = acc + red
-            pending = jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
-                              (0, pad))
-        red, ring_res = ring_reduce_scatter(
-            pending, "data", wire=wire, residual=ring_res,
-            label="tp_ring_grad", comm_scale=comm_scale,
-            scale_sync_axis=ssync)
+            pending = (_bucket_vectors(bm, g) if bm is not None else
+                       jnp.pad(pt.flatten(g)[0].astype(jnp.float32),
+                               (0, pad)))
+        red, ring_res = _ring_all(pending, ring_res)
         acc = acc + red
         g_mine = acc / (n * M)      # mean over data shards and microbatches
         loss = comm.pmean(loss_sum / M, "data", label="loss_allreduce",
                           scale=comm_scale)
 
         raw_flat, unravel = pt.flatten(params)
-        flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+        if bm is None:
+            flat_p = jnp.pad(raw_flat.astype(jnp.float32), (0, pad))
+            pvecs = None
+        else:
+            # Bucketed: per-bucket param-side flat views — the owned slice
+            # is the concat of per-bucket chunks, in ring coordinate order.
+            flat_p = None
+            pvecs = _bucket_vectors(bm, params)
         gather_res = None
+        gres = None
+        if ef:
+            gres = (jnp.concatenate([r[0, 0]
+                                     for r in state.gather_residual])
+                    if bm is not None else state.gather_residual[0, 0])
         shard = lax.axis_index("data")
         if aggregation == "zero1":
-            p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local, local)
-            # Local moment view: [1, 1, local] (data, model)-sharded
-            # vector leaves squeeze to the flat slice; scalars pass.
-            opt_local = jax.tree.map(
-                lambda x: x[0, 0] if getattr(x, "ndim", 0) >= 3 else x,
-                state.opt_state)
-            new_p_mine, opt_local = apply_optimizer(optimizer, g_mine,
-                                                    opt_local, p_mine)
-            opt_state = jax.tree.map(
-                lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
-                           else x), opt_local)
+            if bm is None:
+                p_mine = lax.dynamic_slice_in_dim(flat_p, shard * local,
+                                                  local)
+                # Local moment view: [1, 1, local] (data, model)-sharded
+                # vector leaves squeeze to the flat slice; scalars pass.
+                opt_local = jax.tree.map(
+                    lambda x: x[0, 0] if getattr(x, "ndim", 0) >= 3 else x,
+                    state.opt_state)
+                new_p_mine, opt_local = apply_optimizer(optimizer, g_mine,
+                                                        opt_local, p_mine)
+                opt_state = jax.tree.map(
+                    lambda x: (x[None, None] if getattr(x, "ndim", 0) >= 1
+                               else x), opt_local)
+            else:
+                # One optimizer apply per bucket against the per-bucket
+                # moments; elementwise updates make the concat
+                # value-identical to the single-slice apply.
+                p_chunks = [lax.dynamic_slice_in_dim(
+                    pvecs[b], shard * bm.sizes[b], bm.sizes[b])
+                    for b in range(B)]
+                new_chunks, opts = [], []
+                for b in range(B):
+                    opt_b = jax.tree.map(
+                        lambda x: (x[0, 0] if getattr(x, "ndim", 0) >= 3
+                                   else x), state.opt_state[b])
+                    np_b, opt_b = apply_optimizer(
+                        optimizer,
+                        g_mine[bm.offsets[b]:bm.offsets[b] + bm.sizes[b]],
+                        opt_b, p_chunks[b])
+                    new_chunks.append(np_b)
+                    opts.append(jax.tree.map(
+                        lambda x: (x[None, None]
+                                   if getattr(x, "ndim", 0) >= 1 else x),
+                        opt_b))
+                p_mine = jnp.concatenate(p_chunks)
+                new_p_mine = jnp.concatenate(new_chunks)
+                opt_state = tuple(opts)
+            vec_new = None
             if wire == "int8_ef":
                 # Compressed second leg: broadcast the param DELTA int8
                 # with its own EF residual (the compress.py zero1 rule —
                 # fp32 moments stay exact, data replicas stay bitwise in
                 # sync).
                 q, s, gather_res = _int8_encode(
-                    (new_p_mine - p_mine) + state.gather_residual[0, 0],
+                    (new_p_mine - p_mine) + gres,
                     scale_sync_axis=ssync)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="tp_delta_gather_int8",
@@ -895,20 +1019,31 @@ def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
                 s_all = comm.all_gather(s[None], "data", tiled=True,
                                         label="tp_delta_scale_gather",
                                         scale=comm_scale)
-                flat_new = flat_p + (jnp.repeat(s_all, local)
-                                     * q_all.astype(jnp.float32))
+                if bm is None:
+                    flat_new = flat_p + (jnp.repeat(s_all, local)
+                                         * q_all.astype(jnp.float32))
+                else:
+                    q_slc = _bucket_slices(bm, q_all.astype(jnp.float32))
+                    vec_new = [pvecs[b]
+                               + jnp.repeat(s_all, bm.sizes[b]) * q_slc[b]
+                               for b in range(B)]
             else:
                 # bf16 wire compresses the RING leg only — the param
                 # gather stays fp32 (params stay exact, compress.py rule).
                 flat_new = comm.all_gather(new_p_mine, "data", tiled=True,
                                            label="tp_param_gather",
                                            scale=comm_scale)
-            new_params = unravel(flat_new[:total].astype(raw_flat.dtype))
+                if bm is not None:
+                    vec_new = _bucket_slices(bm, flat_new)
+            if bm is None:
+                new_params = unravel(
+                    flat_new[:total].astype(raw_flat.dtype))
+            else:
+                new_params = _scatter_buckets(bm, vec_new, params)
         else:                       # replicated gradient update
             if wire == "int8_ef":
                 q, s, gather_res = _int8_encode(
-                    g_mine + state.gather_residual[0, 0],
-                    scale_sync_axis=ssync)
+                    g_mine + gres, scale_sync_axis=ssync)
                 q_all = comm.all_gather(q, "data", tiled=True,
                                         label="tp_grad_gather_int8",
                                         scale=comm_scale)
@@ -926,15 +1061,28 @@ def _make_tp_overlap_local_step(cfg: LlamaConfig, optimizer, *, tp: int,
                 flat_g = comm.all_gather(g_mine, "data", tiled=True,
                                          label="tp_grad_gather",
                                          scale=comm_scale)
-            grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            if bm is None:
+                grads = unravel(flat_g[:total].astype(raw_flat.dtype))
+            else:
+                grads = _scatter_buckets(bm, _bucket_slices(bm, flat_g),
+                                         params)
             new_params, opt_state = apply_optimizer(optimizer, grads,
                                                     state.opt_state, params)
         step = state.step + 1
         if ef:
             from .compress import OverlapEFState
+            if bm is not None:
+                # Per-bucket storage: each bucket's stack is a contiguous
+                # ordered-coordinate range (the reshard_state contract).
+                ring_out = tuple(r[None, None] for r in ring_res)
+                gather_out = tuple(
+                    gather_res[bm.offsets[b]:bm.offsets[b] + bm.sizes[b]]
+                    [None, None] for b in range(B))
+            else:
+                ring_out = ring_res[None, None]
+                gather_out = gather_res[None, None]
             new_state = OverlapEFState(new_params, opt_state, step,
-                                       ring_res[None, None],
-                                       gather_res[None, None])
+                                       ring_out, gather_out)
         else:
             new_state = TrainState(new_params, opt_state, step)
         if numerics is not None:
@@ -953,6 +1101,7 @@ def make_tp_overlap_step(cfg: LlamaConfig,
                          wire: str = "fp32",
                          overlap_microbatches: int = 1,
                          psa: str = "",
+                         comm_buckets: int = 1,
                          numerics=None):
     """Per-step DP×TP composition driver: ``step(state, tokens) -> (state,
     loss)`` over a ``[n_data·B, T]`` batch sharded over ``data``, with the
@@ -961,16 +1110,20 @@ def make_tp_overlap_step(cfg: LlamaConfig,
     step_fn)`` — an ``OverlapEFState`` under ``wire="int8_ef"`` (EF
     residuals in the checkpointed tree, per (data, model) shard), a plain
     TrainState otherwise, with ZeRO-1 moments sharded over
-    ``(data, model)`` when ``aggregation="zero1"``."""
-    (state, state_specs, n, pad, local, total, mode,
-     period) = _tp_overlap_setup(optimizer, mesh, params, wire,
-                                 aggregation, psa, cfg.n_layers)
+    ``(data, model)`` when ``aggregation="zero1"``. ``comm_buckets > 1``
+    selects the bucketed backward (per-bucket rings inside each
+    microbatch's VJP window; compress.py contract)."""
+    (state, state_specs, n, pad, local, total, mode, period,
+     bm) = _tp_overlap_setup(optimizer, mesh, params, wire,
+                             aggregation, psa, cfg.n_layers,
+                             comm_buckets=comm_buckets)
     tp = mesh.shape["model"]
     has_data = mesh.shape.get("data", 1) > 1
     local_step = _make_tp_overlap_local_step(
         cfg, optimizer, tp=tp, mode=mode, period=period, n=n, pad=pad,
         local=local, total=total, microbatches=overlap_microbatches,
-        wire=wire, aggregation=aggregation, numerics=numerics)
+        wire=wire, aggregation=aggregation, bucket_map=bm,
+        numerics=numerics)
     out_specs = (state_specs,
                  ((P(), numerics.summary_specs()) if numerics is not None
                   else P()))
@@ -988,6 +1141,7 @@ def make_tp_overlap_multi_step(cfg: LlamaConfig,
                                wire: str = "fp32",
                                overlap_microbatches: int = 1,
                                psa: str = "",
+                               comm_buckets: int = 1,
                                numerics=None):
     """The DP×TP composition driver inside the K-step scan: ``step(state,
     window) -> (state, losses)`` with ``window`` a ``[K, n_data·B, T]``
@@ -997,9 +1151,10 @@ def make_tp_overlap_multi_step(cfg: LlamaConfig,
     and a preempt/resume cycle (pinned in tests/test_tp.py). The scanned
     body IS ``make_tp_overlap_step``'s, so the loss sequence and final
     state are bitwise-identical to K per-step calls at any K."""
-    (state, state_specs, n, pad, local, total, mode,
-     period) = _tp_overlap_setup(optimizer, mesh, params, wire,
-                                 aggregation, psa, cfg.n_layers)
+    (state, state_specs, n, pad, local, total, mode, period,
+     bm) = _tp_overlap_setup(optimizer, mesh, params, wire,
+                             aggregation, psa, cfg.n_layers,
+                             comm_buckets=comm_buckets)
     tp = mesh.shape["model"]
     has_data = mesh.shape.get("data", 1) > 1
 
@@ -1007,7 +1162,7 @@ def make_tp_overlap_multi_step(cfg: LlamaConfig,
         local_step = _make_tp_overlap_local_step(
             cfg, optimizer, tp=tp, mode=mode, period=period, n=n, pad=pad,
             local=local, total=total, microbatches=overlap_microbatches,
-            wire=wire, aggregation=aggregation,
+            wire=wire, aggregation=aggregation, bucket_map=bm,
             comm_scale=window.shape[0], numerics=numerics)
         return lax.scan(local_step, st, window)
 

@@ -1157,6 +1157,15 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
     ovl = train_cfg.overlap_microbatches
     if ovl < 0:
         raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
+    cb = train_cfg.comm_buckets
+    if cb < 1:
+        raise ValueError(f"comm_buckets must be >= 1 (got {cb})")
+    if cb > 1 and ovl == 0:
+        raise ValueError(
+            "comm_buckets > 1 is a property of the overlap/ring driver "
+            "(the bucketed backward splits each microbatch's ring) — set "
+            f"overlap_microbatches >= 1 (got comm_buckets={cb} with "
+            "overlap_microbatches=0)")
     elastic = bool(resilience is not None and resilience.elastic)
     if hier and ovl == 0:
         raise ValueError(
@@ -1253,7 +1262,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                 from ..parallel import compress
                 st, fn = compress.make_overlap_multi_step(
                     loss_fn, optimizer, m, params, microbatches=ovl,
-                    wire=train_cfg.wire, aggregation=aggregation)
+                    wire=train_cfg.wire, aggregation=aggregation,
+                    comm_buckets=cb)
             elif aggregation == "zero1":
                 st, fn = dp.make_zero1_multi_step(loss_fn, optimizer, m,
                                                   params)
@@ -1268,6 +1278,7 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
             fn = introspect.watch(
                 fn, name=f"train/dp-{aggregation}-elastic"
                          + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+                         + (f"-b{cb}" if cb > 1 else "")
                          + f"-w{m.shape['data']}",
                 max_caches=None,
                 events=(telemetry.events if telemetry is not None
@@ -1301,12 +1312,12 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         elif spd > 1:
             state, step_fn = compress.make_overlap_multi_step(
                 loss_fn, optimizer, mesh, params, microbatches=ovl,
-                wire=wire_arg, aggregation=aggregation,
+                wire=wire_arg, aggregation=aggregation, comm_buckets=cb,
                 guard_nonfinite=injit_guard, numerics=numerics)
         else:
             state, step_fn = compress.make_overlap_step(
                 loss_fn, optimizer, mesh, params, microbatches=ovl,
-                wire=wire_arg, aggregation=aggregation,
+                wire=wire_arg, aggregation=aggregation, comm_buckets=cb,
                 guard_nonfinite=injit_guard, numerics=numerics)
     elif train_cfg.wire != "fp32":
         # Compressed gradient allreduce (parallel/compress.py) — gradient
@@ -1388,7 +1399,8 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
                  + ((f"-hier{n_dcn}x{mesh.shape['data']}"
                      f"-{train_cfg.wire}/{train_cfg.wire_dcn or 'fp32'}"
                      f"-m{ovl}") if hier else
-                    (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")),
+                    (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""))
+                 + (f"-b{cb}" if cb > 1 else ""),
             max_caches=(1 if spd == 1 else None),
             events=(telemetry.events if telemetry is not None else None),
             # Chunked mode stamps each compile event with the COMPILING
@@ -1552,6 +1564,15 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
     if ovl < 0:
         raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
+    cb = train_cfg.comm_buckets
+    if cb < 1:
+        raise ValueError(f"comm_buckets must be >= 1 (got {cb})")
+    if cb > 1 and ovl == 0:
+        raise ValueError(
+            "comm_buckets > 1 is a property of the overlap/ring driver "
+            "(the bucketed backward splits each microbatch's ring) — set "
+            f"overlap_microbatches >= 1 (got comm_buckets={cb} with "
+            "overlap_microbatches=0)")
     if train_cfg.dcn != 1 or train_cfg.wire_dcn:
         raise ValueError("hierarchical DP (TrainConfig.dcn / wire_dcn) is "
                          "DP-trainer-only; the pipeline mesh has no "
@@ -1610,7 +1631,7 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
             model_cfg, optimizer, mesh, params,
             n_microbatches=train_cfg.microbatches, schedule=schedule,
             aggregation=aggregation, wire=train_cfg.wire,
-            overlap_microbatches=ovl, numerics=numerics)
+            overlap_microbatches=ovl, comm_buckets=cb, numerics=numerics)
     elif spd > 1:
         state = pp.init_state(mesh, params, optimizer)
         step_fn = pp.make_pipeline_multi_step(
@@ -1634,7 +1655,8 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         name=f"train/pp-{schedule}"
              + (f"-{aggregation}" if aggregation != "gradient" else "")
              + (f"-k{spd}" if spd > 1 else "")
-             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""),
+             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+             + (f"-b{cb}" if cb > 1 else ""),
         max_caches=(1 if spd == 1 else None),
         events=(telemetry.events if telemetry is not None else None),
         meta={"steps_per_dispatch": spd},
@@ -1731,6 +1753,15 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
         raise ValueError(f"steps_per_dispatch must be >= 1 (got {spd})")
     if ovl < 0:
         raise ValueError(f"overlap_microbatches must be >= 0 (got {ovl})")
+    cb = train_cfg.comm_buckets
+    if cb < 1:
+        raise ValueError(f"comm_buckets must be >= 1 (got {cb})")
+    if cb > 1 and ovl == 0:
+        raise ValueError(
+            "comm_buckets > 1 is a property of the overlap/ring driver "
+            "(the bucketed backward splits each microbatch's ring) — set "
+            f"overlap_microbatches >= 1 (got comm_buckets={cb} with "
+            "overlap_microbatches=0)")
     if train_cfg.dcn != 1 or train_cfg.wire_dcn:
         raise ValueError("hierarchical DP (TrainConfig.dcn / wire_dcn) is "
                          "DP-trainer-only; the TP mesh has no two-level "
@@ -1791,7 +1822,8 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
         state, step_fn = maker(
             model_cfg, optimizer, mesh, params,
             aggregation=aggregation, wire=train_cfg.wire,
-            overlap_microbatches=ovl, psa=psa, numerics=numerics)
+            overlap_microbatches=ovl, psa=psa, comm_buckets=cb,
+            numerics=numerics)
     else:
         maker = tp.make_tp_multi_step if spd > 1 else tp.make_tp_step
         state, step_fn = maker(
@@ -1807,7 +1839,8 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
              + (f"-psa-{psa.replace(':', '')}" if psa else "")
              + (f"-{aggregation}" if aggregation != "gradient" else "")
              + (f"-k{spd}" if spd > 1 else "")
-             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else ""),
+             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+             + (f"-b{cb}" if cb > 1 else ""),
         max_caches=(1 if spd == 1 else None),
         events=(telemetry.events if telemetry is not None else None),
         meta={"steps_per_dispatch": spd},

@@ -62,6 +62,13 @@ DERIVED_FIELDS = ("mfu", "attainment")
 # TP-fusion smoke's ``wire_bytes_model_per_train_step`` rows (ISSUE 18):
 # the model-axis activation wire under the PSA modes must only ever
 # trend DOWN vs the committed history, same as the data-axis ring rows.
+# ``overlap_fraction`` (the comm-wire smoke's bucketed-backward row,
+# ISSUE 19: the share of ring hops whose dispatch is
+# dataflow-independent of the not-yet-materialized tail of the gradient)
+# is deliberately NOT in this tuple — MORE overlap is the win, so it
+# keeps the higher-is-better default and gates when the candidate's
+# overlap window SHRINKS below the best committed row (pinned in
+# tests/test_experiments.py).
 LOWER_IS_BETTER_PREFIXES = ("wire_bytes", "payload_bytes",
                             "remesh_seconds", "steps_replayed", "peak_")
 

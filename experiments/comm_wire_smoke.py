@@ -22,7 +22,18 @@ SAME model/mesh in the SAME run, read both static comm profiles
    bytes/step to ≤ 30% of the flat f32 allreduce — the per-axis wire
    budget (``CommProfile.by_axis``), with the DCN ring's accounting
    pinned to the analytic K·M·(D−1)·chunk_bytes formula exactly, and
-   zero retraces at every (islands × island_size) factorization.
+   zero retraces at every (islands × island_size) factorization;
+5. the BUCKETED backward grid (ISSUE 19, ``comm_buckets`` ∈ {1, 2, 8}):
+   each per-bucket ring leg matches its own K·M·(n−1)·size_b formula to
+   the byte, the fp32 total wire and the int8 chunk legs are
+   byte-invariant in the bucket count (sub-1/n chunking re-orders hops,
+   it must not add payload — the int8 rings' only delta is one 4-byte
+   scale per extra bucket per hop), every bucket count still clears the
+   ≤ ~¼ ratio and compiles exactly once, and the overlap window is
+   PROVEN in the jaxpr: at b=8 bucket 0's first ``ppermute`` hop
+   carries no data dependence on the last bucket's VJP
+   (``ring_overlap_evidence``), with the resulting ``overlap_fraction``
+   emitted as a higher-is-better bench_compare row.
 
 Wire-byte rows land in the JSON artifact in the bench_compare row shape
 ({"metric": "wire_bytes_per_train_step", ...}; the DCN budget as
@@ -224,6 +235,90 @@ def run(out_path: str) -> int:
     checks["retraces"] = {"grid": retraces,
                           "ok": all(v["ok"] for v in retraces.values())}
 
+    # ---- bucketed backward grid (ISSUE 19): comm_buckets ∈ {1, 2, 8} ----
+    from ddl25spring_tpu.parallel.compress import (make_bucket_map,
+                                                   ring_overlap_evidence)
+    bucket_grid, fp32_totals, int8_chunk_totals = {}, {}, {}
+    for b in (1, 2, 8):
+        sizes = list(make_bucket_map(fresh_params(), n, b).sizes)
+        # fp32 ring at this bucket count: trace-time profile only — the
+        # TOTAL wire must be byte-identical to the unbucketed ring.
+        fst, ffn = compress.make_overlap_multi_step(
+            loss_fn, opt(), mesh, fresh_params(), microbatches=1,
+            wire="fp32", aggregation="zero1", comm_buckets=b)
+        fp32_totals[b] = measure_comm(
+            ffn, fst, window_sds).wire_bytes_per_device_per_step
+
+        # int8 ring: executed 3× under CompileWatch (zero retraces), the
+        # per-bucket ring legs checked against K·M·(n−1)·size_b exactly.
+        st, fn = compress.make_overlap_multi_step(
+            loss_fn, opt(), mesh, fresh_params(), microbatches=1,
+            wire="int8_ef", aggregation="zero1", comm_buckets=b)
+        prof = measure_comm(fn, st, window_sds)
+        wfn = introspect.watch(fn, name=f"smoke/int8-b{b}", max_caches=1)
+        loss = None
+        for _ in range(3):
+            st, losses = wfn(st, dp.shard_batch_window(mesh, window))
+            loss = float(np.asarray(losses)[-1])
+        byb = prof.by_label()
+        per_bucket, chunk_total = {}, 0
+        for i, sz in enumerate(sizes):
+            stem = "ring_grad" if b == 1 else f"ring_grad_b{i}"
+            gp = int(byb[f"{stem}_int8"]["payload_bytes"])
+            gs = int(byb[f"{stem}_scale"]["payload_bytes"])
+            chunk_total += gp
+            per_bucket[stem] = {
+                "payload": {"got": gp, "want": K * (n - 1) * sz},
+                "scales": {"got": gs, "want": K * (n - 1) * 4},
+                "ok": bool(gp == K * (n - 1) * sz
+                           and gs == K * (n - 1) * 4)}
+        int8_chunk_totals[b] = chunk_total
+        wire = prof.wire_bytes_per_device_per_step / K
+        bucket_grid[f"b{b}"] = {
+            "per_bucket": per_bucket,
+            "wire_bytes_per_step": wire,
+            "wire_ratio_vs_f32": wire / base_wire,
+            "compiles": len(wfn.compiles),
+            "retraces": sum(1 for c in wfn.compiles if c.retrace),
+            "final_loss": loss,
+            "ok": bool(all(v["ok"] for v in per_bucket.values())
+                       and wire / base_wire <= 0.26
+                       and len(wfn.compiles) == 1
+                       and not any(c.retrace for c in wfn.compiles)
+                       and np.isfinite(loss))}
+        rows.append({"metric": "wire_bytes_per_train_step", "value": wire,
+                     "unit": "bytes/device/step", "platform": "cpu",
+                     "variant": f"int8ef+zero1+scan4-b{b}"})
+    checks["bucket_grid"] = {
+        "grid": bucket_grid,
+        "fp32_wire_invariant": len(set(fp32_totals.values())) == 1,
+        "int8_chunk_invariant": len(set(int8_chunk_totals.values())) == 1,
+        "ok": (all(v["ok"] for v in bucket_grid.values())
+               and len(set(fp32_totals.values())) == 1
+               and len(set(int8_chunk_totals.values())) == 1)}
+
+    # The overlap window itself, in the jaxpr (the acceptance bar):
+    # bucket 0's first ppermute hop at b=8 is dataflow-independent of the
+    # last bucket's VJP; unbucketed the same predicate is False — the
+    # evidence is a property of the chunking, not of the tracer.
+    batch1 = window[0]
+    ev = {}
+    for b in (1, 8):
+        est, estep = compress.make_overlap_step(
+            loss_fn, opt(), mesh, fresh_params(), microbatches=1,
+            wire="int8_ef", aggregation="zero1", comm_buckets=b)
+        ev[f"b{b}"] = ring_overlap_evidence(
+            estep, est, dp.shard_batch(mesh, batch1))
+    checks["overlap_evidence"] = {
+        "b1": ev["b1"], "b8": ev["b8"],
+        "ok": (ev["b8"]["first_hop_independent"]
+               and not ev["b1"]["first_hop_independent"]
+               and ev["b8"]["overlap_fraction"]
+               > ev["b1"]["overlap_fraction"])}
+    rows.append({"metric": "overlap_fraction",
+                 "value": ev["b8"]["overlap_fraction"], "unit": "fraction",
+                 "platform": "cpu", "variant": "int8ef+zero1-b8"})
+
     ok = all(c["ok"] for c in checks.values())
     doc = {"ok": ok, "n_devices": n, "steps_per_dispatch": K,
            "model": {"dmodel": cfg.dmodel, "n_layers": cfg.n_layers,
@@ -235,6 +330,9 @@ def run(out_path: str) -> int:
           f"dcn ratio {dcn_ratio:.3f} (budget 0.30), "
           f"ring accounting {'exact' if checks['ring_analytic']['ok'] else 'WRONG'}, "
           f"dcn accounting {'exact' if checks['hier_dcn_analytic']['ok'] else 'WRONG'}, "
+          f"buckets {'exact' if checks['bucket_grid']['ok'] else 'WRONG'}, "
+          f"overlap b8 {ev['b8']['overlap_fraction']:.2f} "
+          f"(first hop {'free' if ev['b8']['first_hop_independent'] else 'WAITED'}), "
           f"retraces {'clean' if checks['retraces']['ok'] and checks['hier_retraces']['ok'] else 'DIRTY'} "
           f"-> {out_path}", file=sys.stderr)
     return 0 if ok else 1

@@ -39,8 +39,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
                 telemetry_dir: str = None, steps_per_dispatch: int = 1,
                 zero1: bool = False, elastic: bool = False,
                 numerics_every: int = 0, wire: str = "fp32",
-                overlap_microbatches: int = 0, dcn: int = 1,
-                wire_dcn: str = "") -> Dict[str, float]:
+                overlap_microbatches: int = 0, comm_buckets: int = 1,
+                dcn: int = 1, wire_dcn: str = "") -> Dict[str, float]:
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
@@ -56,6 +56,7 @@ def _run_config(name: str, iters: int, sink, provenance: str,
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
                             numerics_every=numerics_every, wire=wire,
                             overlap_microbatches=overlap_microbatches,
+                            comm_buckets=comm_buckets,
                             dcn=dcn, wire_dcn=wire_dcn,
                             **topo)  # batch 3/shard, Adam 8e-4
     model_cfg = LlamaConfig(dtype="bfloat16")
@@ -68,6 +69,8 @@ def _run_config(name: str, iters: int, sink, provenance: str,
         label += f"_{wire}"
     if overlap_microbatches:
         label += f"_ring_m{overlap_microbatches}"
+    if comm_buckets > 1:
+        label += f"_buckets{comm_buckets}"
     if dcn > 1:
         label += f"_hier{dcn}x{train_cfg.data}_{wire_dcn or 'fp32'}"
     log_every = max(1, min(iters // 10, 25))
@@ -154,8 +157,8 @@ def main(quick: bool = False, iters: int = 5000,
          telemetry_dir: str = None, steps_per_dispatch: int = 1,
          zero1: bool = False, elastic: bool = False,
          numerics_every: int = 0, wire: str = "fp32",
-         overlap_microbatches: int = 0, dcn: int = 1,
-         wire_dcn: str = "") -> Dict[str, float]:
+         overlap_microbatches: int = 0, comm_buckets: int = 1,
+         dcn: int = 1, wire_dcn: str = "") -> Dict[str, float]:
     """``configs`` picks topologies from CONFIGS; the multi-device ones need
     >= 6 (virtual) devices — run_all keeps the dp1 default so the suite works
     on a single real chip, and the pipeline rows are appended by
@@ -187,6 +190,7 @@ def main(quick: bool = False, iters: int = 5000,
                                zero1=zero1, elastic=elastic,
                                numerics_every=numerics_every, wire=wire,
                                overlap_microbatches=overlap_microbatches,
+                               comm_buckets=comm_buckets,
                                dcn=dcn, wire_dcn=wire_dcn))
     print(f"-> {sink.path}")
     # run_all compatibility: single-config calls keep the old summary keys.
@@ -260,6 +264,16 @@ if __name__ == "__main__":
                          "microbatch k's ppermute ring reduce-scatter, "
                          "in-flight chunks in --wire's format; 1 = "
                          "no-split compressed ring, 0 = legacy paths")
+    ap.add_argument("--comm-buckets", type=int, default=1,
+                    help="bucketed backward (ISSUE 19): split each "
+                         "microbatch's ring into N VJP-emission-ordered "
+                         "buckets so the first ppermute hop dispatches "
+                         "before the full gradient materializes; total "
+                         "wire bytes invariant in N (needs "
+                         "--overlap-microbatches >= 1; composes with "
+                         "--wire/--zero1/--steps-per-dispatch on DP, PP "
+                         "and hierarchical configs; recorded in the run "
+                         "manifest)")
     ap.add_argument("--dcn", type=int, default=1,
                     help="hierarchical DP: --dcn islands of --data-sized "
                          "ICI tiers bridged by DCN (hier_data_mesh); the "
@@ -292,5 +306,5 @@ if __name__ == "__main__":
          telemetry_dir=a.telemetry_dir,
          steps_per_dispatch=a.steps_per_dispatch, zero1=a.zero1,
          elastic=a.elastic, numerics_every=a.numerics_every, wire=a.wire,
-         overlap_microbatches=a.overlap_microbatches, dcn=a.dcn,
-         wire_dcn=a.wire_dcn)
+         overlap_microbatches=a.overlap_microbatches,
+         comm_buckets=a.comm_buckets, dcn=a.dcn, wire_dcn=a.wire_dcn)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 import time
 
@@ -99,6 +100,13 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
         psa = (manifest.get("train_cfg") or {}).get("psa")
         if psa:
             print(f"psa: {psa}")
+        # Likewise the bucketed backward (ISSUE 19): the per-label rows
+        # below split into per-bucket ring legs under comm_buckets > 1,
+        # and a reader comparing dispatch counts across runs needs to
+        # know the bucket count up front.
+        cb = (manifest.get("train_cfg") or {}).get("comm_buckets")
+        if isinstance(cb, int) and cb > 1:
+            print(f"comm_buckets: {cb}")
 
     comm = (manifest or {}).get("comm")
     if comm:
@@ -117,8 +125,20 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
         # PSA activation-sync budget (tp.psa_sync_wire_bytes) — so a
         # single-axis TP manifest still renders the table. Absent on
         # pre-PR-12 manifests — skip silently.
+        # Per-bucket ring dispatch counts (ISSUE 19 bucketed backward):
+        # fold the per-label ``*ring_grad_b<N>*`` legs into per-axis
+        # bucket tallies so the wire-budget table shows how many times
+        # each bucket's ring dispatched — the sub-1/n chunking's dispatch
+        # overhead, next to the bytes it re-orders.
+        bucket_calls = {}
+        for label, agg in comm["collectives"].items():
+            m = re.search(r"ring_grad_b(\d+)", str(label))
+            if m:
+                per_ax = bucket_calls.setdefault(agg.get("axis"), {})
+                b = int(m.group(1))
+                per_ax[b] = per_ax.get(b, 0) + agg.get("calls", 0)
         axes = comm.get("axes")
-        if axes and (len(axes) > 1 or "model" in axes):
+        if axes and (len(axes) > 1 or "model" in axes or bucket_calls):
             print("per-axis wire budget:")
             for ax, agg in sorted(axes.items(),
                                   key=lambda kv:
@@ -130,6 +150,15 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
                       f"{_fmt_bytes(agg['wire_bytes_per_device']):>12s}"
                       + (f"  ({_fmt_bytes(per_ts)}/step)"
                          if per_ts is not None else ""))
+                bk = bucket_calls.get(ax)
+                if bk:
+                    counts = sorted(set(bk.values()))
+                    detail = (f"x{counts[0]} dispatches each"
+                              if len(counts) == 1 else
+                              "  ".join(f"b{b}:x{c}"
+                                        for b, c in sorted(bk.items())))
+                    print(f"    bucketed ring: {len(bk)} buckets  "
+                          f"{detail}")
 
     if steps:
         _section("steps")

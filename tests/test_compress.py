@@ -512,3 +512,294 @@ def test_overlap_trainer_composition_and_guards(devices):
                          tokenizer=ByteTokenizer(), aggregation="zero1",
                          mesh=mesh(), log_every=0)
     assert instr.losses == ref.losses
+
+
+# ---------------------------------------------------------------------------
+# Bucketed backward (comm_buckets > 1): sub-1/n ring chunking that starts
+# the first hop before the full gradient materializes (ISSUE 19).
+# ---------------------------------------------------------------------------
+
+
+def _llama_setup(key=0):
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+
+    cfg = LlamaConfig(vocab_size=64, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=8)
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    return cfg, loss_fn, llama.init_llama(jax.random.key(key), cfg)
+
+
+def test_bucket_map_covers_in_vjp_emission_order():
+    """The BucketMap partitions the padded flat space exactly once, with
+    lm_head first and the embedding last (top-of-network buckets first —
+    the VJP emission order that makes early rings independent of late
+    grads), blocks layers walked top-down, and the global pad riding the
+    LAST bucket's tail."""
+    _, _, params = _llama_setup()
+    n = 4
+    for B in (1, 2, 3, 8):
+        bm = compress.make_bucket_map(params, n, B)
+        assert bm.nbuckets == B
+        assert sum(bm.sizes) == bm.local
+        assert bm.n * bm.local == bm.total + bm.pad
+        # pieces tile [0, n·local) exactly once, in order
+        pos = 0
+        for _, start, size in [pc for b in bm.pieces for pc in b]:
+            del start
+            pos += size
+        assert pos + bm.pad == bm.n * bm.local
+    bm = compress.make_bucket_map(params, n, 8)
+    leaf_order = [pc[0] for b in bm.pieces for pc in b]
+    paths = [jax.tree_util.keystr(p) for p, _ in
+             jax.tree_util.tree_flatten_with_path(params)[0]]
+    first_key = paths[leaf_order[0]]
+    last_key = paths[leaf_order[-1]]
+    assert "lm_head" in first_key, first_key
+    assert "embed" in last_key, last_key
+    with pytest.raises(ValueError, match="exceeds the per-shard slice"):
+        compress.make_bucket_map(params, n, 10 ** 9)
+
+
+def test_bucketed_fp32_ring_bitwise_at_every_bucket_count(devices):
+    """THE house bar, at the ring level: on exact-arithmetic inputs
+    (small integers — every fp32 sum is exact regardless of association)
+    the per-bucket rings and the unbucketed ``ring_reduce_scatter``
+    BITWISE agree with the exact cross-shard sum — hence with each other
+    — at every bucket count. Bucketing re-chunks the ring and reorders
+    coordinates, which can only reassociate sums; exact sums don't
+    care."""
+    mesh = _mesh4(devices)
+    n, local = 4, 16
+    params = {"w": jnp.zeros((n * local,))}   # single leaf: no pad
+    xs = np.asarray(jax.random.randint(jax.random.key(9),
+                                       (n, n * local), -50, 50),
+                    dtype=np.float32)
+    exact = xs.sum(axis=0)                    # integer sums: exact in fp32
+
+    for B in (1, 2, 3, 8):
+        bm = compress.make_bucket_map(params, n, B)
+
+        def body(x):
+            v = x.reshape(-1)
+            outs = []
+            for b in range(bm.nbuckets):
+                o = bm.n * bm.offsets[b]
+                red, _ = compress.ring_reduce_scatter(
+                    v[o:o + bm.n * bm.sizes[b]], "data", wire="fp32",
+                    residual=None, label=f"ring_grad_b{b}")
+                outs.append(red)
+            return jnp.concatenate(outs)[None]
+
+        got = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False))(jnp.asarray(xs))
+        got = np.asarray(got)                 # [n, local] owned concats
+        for r in range(n):
+            want = np.concatenate([
+                exact[bm.n * bm.offsets[b] + r * bm.sizes[b]:
+                      bm.n * bm.offsets[b] + (r + 1) * bm.sizes[b]]
+                for b in range(bm.nbuckets)])
+            np.testing.assert_array_equal(got[r], want)
+
+
+def test_bucketed_driver_fp32_matches_unbucketed(devices):
+    """Driver level: the first step from w=0 on integer data is exact
+    arithmetic end-to-end (integer gradients, dyadic lr) — losses AND
+    params bitwise across bucket counts; further steps accumulate only
+    reassociation-level float noise (losses stay equal, params to fp32
+    tolerance), for both aggregations."""
+    mesh = _mesh4(devices)
+    dim = 64
+    k1, k2 = jax.random.split(jax.random.key(7))
+    w_star = jnp.round(jax.random.normal(k1, (dim,)) * 3)
+    x = jnp.round(jax.random.normal(k2, (64, dim)) * 2)
+    y = x @ w_star
+    batch = jnp.concatenate([x, y[:, None]], axis=-1)
+
+    def loss_fn(p, b):
+        xb, yb = b[..., :-1], b[..., -1]
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    def run(B, agg, steps):
+        state, step = compress.make_overlap_step(
+            loss_fn, optax.sgd(2. ** -4), mesh, {"w": jnp.zeros((dim,))},
+            microbatches=2, wire="fp32", aggregation=agg, comm_buckets=B)
+        losses = []
+        for _ in range(steps):
+            state, l = step(state, dp.shard_batch(mesh, batch))
+            losses.append(float(l))
+        return losses, np.asarray(state.params["w"])
+
+    for agg in ("gradient", "zero1"):
+        ref1_l, ref1_w = run(1, agg, 1)
+        ref4_l, ref4_w = run(1, agg, 4)
+        for B in (2, 3, 8):
+            got_l, got_w = run(B, agg, 1)
+            assert got_l == ref1_l, (agg, B, ref1_l, got_l)
+            np.testing.assert_array_equal(got_w, ref1_w)
+            got_l, got_w = run(B, agg, 4)
+            assert got_l == ref4_l, (agg, B, ref4_l, got_l)
+            np.testing.assert_allclose(got_w, ref4_w, atol=1e-6, rtol=0)
+
+
+def test_bucketed_int8_converges_on_quadratic():
+    """int8 wire × comm_buckets=4: per-bucket quantization + per-bucket EF
+    residual tuples hold the PR 10 convergence bound on the convex
+    problem, for both aggregations."""
+    mesh = _mesh2()
+    params, loss_fn, batch, _ = _quadratic_setup(jax.random.key(3))
+    for agg in ("gradient", "zero1"):
+        state, step = compress.make_overlap_step(
+            loss_fn, optax.sgd(0.05), mesh,
+            jax.tree.map(jnp.copy, params), microbatches=2,
+            wire="int8_ef", aggregation=agg, comm_buckets=4)
+        sb = dp.shard_batch(mesh, batch)
+        losses = []
+        for _ in range(60):
+            state, loss = step(state, sb)
+            losses.append(float(loss))
+        assert losses[-1] < 1e-2 * losses[0], (agg, losses[0], losses[-1])
+
+
+@pytest.mark.parametrize("wire", ["fp32", "int8_ef"])
+def test_bucketed_multi_step_bitwise_matches_per_step(devices, wire):
+    """K-scan at a FIXED bucket count is bitwise vs per-step dispatch —
+    the per-bucket EF residual tuples and per-bucket ZeRO-1 moments
+    thread the scan carry exactly (the make_multi_step contract carried
+    to the bucketed ring)."""
+    from ddl25spring_tpu.models import llama
+
+    mesh = _mesh4(devices)
+    cfg, loss_fn, _ = _llama_setup()
+    ks = jax.random.split(jax.random.key(2), 4)
+    batches = [jax.random.randint(k, (8, 8), 0, 64) for k in ks]
+
+    s1, step1 = compress.make_overlap_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg),
+        microbatches=2, wire=wire, aggregation="zero1", comm_buckets=2)
+    ref = []
+    for b in batches:
+        s1, l = step1(s1, dp.shard_batch(mesh, b))
+        ref.append(float(l))
+
+    sK, stepK = compress.make_overlap_multi_step(
+        loss_fn, optax.adam(1e-3), mesh,
+        llama.init_llama(jax.random.key(0), cfg),
+        microbatches=2, wire=wire, aggregation="zero1", comm_buckets=2)
+    sK, losses = stepK(sK, dp.shard_batch_window(mesh, np.stack(batches)))
+    assert [float(x) for x in np.asarray(losses)] == ref
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(sK)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bucketed_preempt_resume_bitwise(devices):
+    """The acceptance bar at comm_buckets=8: an int8+EF bucketed run
+    (zero1, K=2) interrupted at a chunk edge and resumed from checkpoint
+    walks BITWISE the uninterrupted trajectory — the per-bucket EF
+    residual tuples ride the checkpointed state tree whole."""
+    from ddl25spring_tpu.config import LlamaConfig, TrainConfig
+    from ddl25spring_tpu.tokenizers import ByteTokenizer
+    from ddl25spring_tpu.train import train_llm_dp
+
+    cfg = LlamaConfig(vocab_size=259, dmodel=16, num_heads=2, n_layers=2,
+                      ctx_size=16)
+    base = dict(batch_size=2, seq_len=16, lr=3e-3, data=2, wire="int8_ef",
+                overlap_microbatches=2, steps_per_dispatch=2,
+                comm_buckets=8)
+    mesh = lambda: make_mesh({"data": 2}, devices=devices[:2])  # noqa: E731
+
+    ref = train_llm_dp(cfg, TrainConfig(**base, iters=6),
+                       tokenizer=ByteTokenizer(), aggregation="zero1",
+                       mesh=mesh(), log_every=0)
+    import tempfile
+    d = tempfile.mkdtemp()
+    a = train_llm_dp(cfg, TrainConfig(**base, iters=4),
+                     tokenizer=ByteTokenizer(), aggregation="zero1",
+                     mesh=mesh(), log_every=0, checkpoint_dir=d,
+                     checkpoint_every=100)
+    b = train_llm_dp(cfg, TrainConfig(**base, iters=6),
+                     tokenizer=ByteTokenizer(), aggregation="zero1",
+                     mesh=mesh(), log_every=0, checkpoint_dir=d,
+                     checkpoint_every=100)
+    assert a.losses + b.losses == ref.losses
+    assert all(np.isfinite(ref.losses))
+
+
+def test_ring_overlap_evidence_positive_and_negative(devices):
+    """The PR 10 evidence standard, applied within the backward: at B=1
+    the first ring hop depends on the WHOLE backward scan (overlap
+    fraction 0, first hop waits); at B=8 M=1 the lm_head bucket's hops
+    are dataflow-independent of the blocks' VJP scan — first hop starts
+    before the full gradient materializes. Asserted on the jaxpr, not on
+    timings."""
+    from ddl25spring_tpu.config import LlamaConfig
+    from ddl25spring_tpu.models import llama
+
+    mesh = _mesh4(devices)
+    cfg = LlamaConfig(vocab_size=259, dmodel=32, num_heads=2, n_layers=2,
+                      ctx_size=16)
+
+    def loss_fn(p, b):
+        return llama.forward_loss(p, b, cfg)
+
+    def evidence(B, M):
+        state, step = compress.make_overlap_step(
+            loss_fn, optax.adam(1e-3), mesh,
+            llama.init_llama(jax.random.key(0), cfg),
+            microbatches=M, wire="int8_ef", aggregation="zero1",
+            comm_buckets=B)
+        batch = dp.shard_batch(
+            mesh, jax.random.randint(jax.random.key(1),
+                                     (4 * M, 16), 0, 259))
+        return compress.ring_overlap_evidence(step, state, batch)
+
+    ev1 = evidence(1, 1)
+    assert ev1["overlap_fraction"] == 0.0
+    assert not ev1["first_hop_independent"]
+
+    ev8 = evidence(8, 1)
+    assert ev8["first_hop_independent"], ev8
+    assert ev8["overlap_fraction"] > 0.0, ev8
+    assert ev8["n_ring_hops"] == 8 * ev1["n_ring_hops"]
+
+
+def test_bucketed_zero_retraces_across_grid(devices):
+    """Zero retraces across the comm_buckets × wire × K grid: every
+    config compiles exactly ONE program across repeated dispatches
+    (max_caches=1 — a second trace is a hard failure)."""
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.telemetry import introspect
+
+    mesh = _mesh4(devices)
+    cfg, loss_fn, _ = _llama_setup()
+    batches = [np.asarray(jax.random.randint(k, (8, 8), 0, 64))
+               for k in jax.random.split(jax.random.key(5), 3)]
+    for B in (2, 8):
+        for wire in ("fp32", "int8_ef"):
+            for K in (1, 2):
+                if K == 1:
+                    state, step = compress.make_overlap_step(
+                        loss_fn, optax.adam(1e-3), mesh,
+                        llama.init_llama(jax.random.key(0), cfg),
+                        microbatches=2, wire=wire, aggregation="zero1",
+                        comm_buckets=B)
+                    step = introspect.watch(
+                        step, name=f"grid-b{B}-{wire}-k1", max_caches=1)
+                    for b in batches:
+                        state, _ = step(state, dp.shard_batch(mesh, b))
+                else:
+                    state, step = compress.make_overlap_multi_step(
+                        loss_fn, optax.adam(1e-3), mesh,
+                        llama.init_llama(jax.random.key(0), cfg),
+                        microbatches=2, wire=wire, aggregation="zero1",
+                        comm_buckets=B)
+                    step = introspect.watch(
+                        step, name=f"grid-b{B}-{wire}-k2", max_caches=1)
+                    w = dp.shard_batch_window(mesh, np.stack(batches[:2]))
+                    for _ in range(2):
+                        state, _ = step(state, w)

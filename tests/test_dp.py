@@ -316,6 +316,59 @@ def test_multi_step_comm_profile_per_step_parity(devices):
         1.01 * p1.wire_bytes_per_device_per_step
 
 
+def test_comm_profile_bucket_invariance(devices):
+    """Chunking reshapes, never inflates (ISSUE 19 satellite, beside the
+    K×/M normalization pins): across comm_buckets ∈ {1, 2, 8} the fp32
+    ring's total wire AND payload bytes are EXACTLY equal (the per-bucket
+    rings move the same (n−1)/n of the same coordinates; the gather legs
+    stay one collective), and the int8 ring's chunk payload is exactly
+    invariant too — the ONLY growth is the analytic 4-byte-scale
+    sideband, one scale hop per extra bucket, and the total wire delta
+    equals that sideband to the byte."""
+    from ddl25spring_tpu.parallel import compress
+    from ddl25spring_tpu.telemetry import measure_comm
+
+    mesh = make_mesh({"data": 4}, devices=devices[:4])
+    sds1 = jax.ShapeDtypeStruct((8, 8), jnp.int32)
+
+    def profile(wire, B):
+        state, step = compress.make_overlap_step(
+            _loss_fn, optax.adam(1e-3), mesh,
+            llama.init_llama(jax.random.key(0), TINY),
+            microbatches=2, wire=wire, aggregation="zero1",
+            comm_buckets=B)
+        p = measure_comm(step, state, sds1)
+        assert p is not None
+        return p
+
+    def scale_bytes(p):
+        return sum(v["wire_bytes_per_device"]
+                   for k, v in p.by_label().items() if "_scale" in k
+                   and "gather" not in k)
+
+    def int8_ring_payload(p):
+        return sum(v["payload_bytes"] for k, v in p.by_label().items()
+                   if "ring_grad" in k and k.endswith("_int8"))
+
+    ref = profile("fp32", 1)
+    for B in (2, 8):
+        got = profile("fp32", B)
+        assert got.wire_bytes_per_device_per_step == \
+            ref.wire_bytes_per_device_per_step
+        assert got.payload_bytes_per_step == ref.payload_bytes_per_step
+
+    ref8 = profile("int8_ef", 1)
+    for B in (2, 8):
+        got8 = profile("int8_ef", B)
+        # chunk payload exactly invariant: Σ_b (n−1)·sizes[b] = (n−1)·local
+        assert int8_ring_payload(got8) == int8_ring_payload(ref8)
+        # the wire delta is the scale sideband and NOTHING else
+        extra = scale_bytes(got8) - scale_bytes(ref8)
+        assert scale_bytes(got8) == B * scale_bytes(ref8)
+        assert got8.wire_bytes_per_device_per_step - \
+            ref8.wire_bytes_per_device_per_step == extra
+
+
 def test_train_llm_dp_chunked_matches_per_step(devices):
     """Trainer-level fusion equivalence: steps_per_dispatch=4 (including a
     tail chunk — iters=6 is not a multiple) walks bitwise the same loss

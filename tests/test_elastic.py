@@ -48,11 +48,11 @@ def _mesh(devices, n):
 
 def _train(devices, n, *, iters=8, tmp=None, name=None, agg="zero1",
            spd=2, resilience=None, checkpoint_every=1000, wire="fp32",
-           ovl=0):
+           ovl=0, cb=1):
     return train_llm_dp(
         TINY,
         TrainConfig(**BASE, iters=iters, data=n, steps_per_dispatch=spd,
-                    wire=wire, overlap_microbatches=ovl),
+                    wire=wire, overlap_microbatches=ovl, comm_buckets=cb),
         mesh=_mesh(devices, n), tokenizer=ByteTokenizer(), aggregation=agg,
         log_every=0, resilience=resilience,
         checkpoint_dir=None if tmp is None else str(tmp / name),
@@ -529,6 +529,120 @@ def test_elastic_ring_int8_round_trip_bitwise(tmp_path, devices):
     _prune_to(tmp_path, "el", "cmp", m)
     ref4 = _train(devices, 4, iters=8, spd=1, tmp=tmp_path, name="cmp",
                   wire="int8_ef", ovl=2, checkpoint_every=1000)
+    assert ref4.start_step == m
+    assert el.losses[m:] == ref4.losses
+
+
+def test_reshard_state_bucketed_residual_tuples(devices):
+    """comm_buckets > 1 reshard (ISSUE 19): the per-bucket EF residual
+    tuples resize bucket-by-bucket when every interior bucket's
+    coordinate span survives the world change (TINY at B=5: 23260
+    params split into five 4652-coordinate buckets at BOTH 4-way and
+    2-way), the 1-D gather-residual buckets ride through bitwise, and
+    the two refusals fire by name: a snapshot/template bucket-count
+    mismatch, and an indivisible bucket×shard factorization (B=2:
+    the 4-way leading bucket spans 4·2908 = 11632 coordinates, the
+    2-way one 2·5815 = 11630)."""
+    from ddl25spring_tpu.parallel import compress
+
+    params = llama.init_llama(jax.random.key(0), TINY)
+
+    def loss_fn(p, batch):
+        return causal_lm_loss(llama.forward(p, batch, TINY), batch)
+
+    def build(n, buckets):
+        mesh = _mesh(devices, n)
+        state, step = compress.make_overlap_step(
+            loss_fn, optax.adam(1e-3), mesh, params, microbatches=2,
+            wire="int8_ef", aggregation="zero1", comm_buckets=buckets)
+        return mesh, state, step
+
+    mesh4, state4, step4 = build(4, 5)
+    batch = jax.random.randint(jax.random.key(1), (8, 16), 0, 259)
+    for _ in range(2):                         # non-zero EF residuals
+        state4, _ = step4(state4, dp.shard_batch(mesh4, batch))
+    host = dp.host_snapshot(state4)
+    assert isinstance(host.ring_residual, tuple)
+    assert len(host.ring_residual) == 5
+    assert any(np.asarray(r).any() for r in host.ring_residual)
+
+    # 4 -> 2: each bucket's ring rows re-chunk 4×1163 -> 2×2326 with the
+    # same 4652-coordinate span, so surviving rows keep every coordinate
+    # outside the old/new own chunks and the new own chunk is re-zeroed.
+    _, t2, _ = build(2, 5)
+    s2 = dp.reshard_state(host, t2)
+    assert len(s2.ring_residual) == 5
+    for h, t in zip(host.ring_residual, s2.ring_residual):
+        h, tv = np.asarray(h), np.asarray(t)
+        assert h.shape == (4, 4652) and tv.shape == (2, 4652)
+        for r in range(2):
+            np.testing.assert_array_equal(
+                tv[r, r * 2326:(r + 1) * 2326], 0.0)
+            keep = [c for c in range(4652)
+                    if not (r * 2326 <= c < (r + 1) * 2326)
+                    and not (r * 1163 <= c < (r + 1) * 1163)]
+            np.testing.assert_array_equal(tv[r, keep], h[r, keep])
+    # Gather residuals are 1-D [span] globals per bucket: span-invariant
+    # worlds carry them through bitwise.
+    for h, t in zip(host.gather_residual, s2.gather_residual):
+        np.testing.assert_array_equal(np.asarray(h), np.asarray(t))
+
+    # Round trip back to 4-way: rows 0/1 keep the surviving coordinates,
+    # rows 2/3 return as zeros (their corrections left with the mesh).
+    _, t4, _ = build(4, 5)
+    s4 = dp.reshard_state(dp.host_snapshot(s2), t4)
+    for h, t in zip(host.ring_residual, s4.ring_residual):
+        h, tv = np.asarray(h), np.asarray(t)
+        assert tv.shape == (4, 4652)
+        np.testing.assert_array_equal(tv[2:], 0.0)
+        for r in range(2):
+            keep = [c for c in range(4652)
+                    if not (r * 2326 <= c < (r + 1) * 2326)
+                    and not (r * 1163 <= c < (r + 1) * 1163)]
+            np.testing.assert_array_equal(tv[r, keep], h[r, keep])
+    for h, t in zip(host.gather_residual, s4.gather_residual):
+        np.testing.assert_array_equal(np.asarray(h), np.asarray(t))
+    for h, t in zip(jax.tree.leaves(host.params),
+                    jax.tree.leaves(s4.params)):
+        np.testing.assert_array_equal(np.asarray(h), np.asarray(t))
+
+    # Refusal 1: snapshot carries 5 residual buckets, template a single
+    # legacy array — rebucketing a live EF state is not defined.
+    _, t1, _ = build(2, 1)
+    with pytest.raises(ValueError, match="comm_buckets mismatch"):
+        dp.reshard_state(host, t1)
+
+    # Refusal 2: B=2 interior spans differ across 4-way/2-way — named.
+    _, s4b2, _ = build(4, 2)
+    _, t2b2, _ = build(2, 2)
+    with pytest.raises(ValueError,
+                       match="indivisible bucket×shard factorization"):
+        dp.reshard_state(dp.host_snapshot(s4b2), t2b2)
+
+
+def test_elastic_bucketed_ring_int8_round_trip_bitwise(tmp_path, devices):
+    """Elastic × bucketed backward (ISSUE 19 satellite): 4→2→4 under the
+    int8-EF ring with comm_buckets=5 — TINY's five 4652-coordinate
+    buckets have world-invariant spans at 4-way and 2-way, so the
+    per-bucket residual tuples reshard in both directions and the
+    post-grow trajectory is bitwise a fresh bucketed 4-replica run
+    restored from the grow point. (A 4→3 shrink changes the interior
+    spans and is refused by name — pinned in
+    test_reshard_state_bucketed_residual_tuples.)"""
+    el = _train(devices, 4, iters=8, spd=1, tmp=tmp_path, name="el",
+                wire="int8_ef", ovl=2, cb=5,
+                resilience=ResilienceConfig(
+                    elastic=True, mirror_every=1,
+                    faults="device_loss@2:2,device_return@5:2"))
+    assert [r["direction"] for r in el.remeshes] == ["shrink", "grow"]
+    assert [(r["old_world"], r["new_world"]) for r in el.remeshes] == \
+        [(4, 2), (2, 4)]
+    assert len(el.losses) == 8 and np.isfinite(el.losses).all()
+
+    m = el.remeshes[1]["resume_step"]
+    _prune_to(tmp_path, "el", "cmp", m)
+    ref4 = _train(devices, 4, iters=8, spd=1, tmp=tmp_path, name="cmp",
+                  wire="int8_ef", ovl=2, cb=5, checkpoint_every=1000)
     assert ref4.start_step == m
     assert el.losses[m:] == ref4.losses
 

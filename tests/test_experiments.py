@@ -186,6 +186,9 @@ def test_bench_compare_direction_aware_gating(tmp_path):
     assert lower_is_better("wire_bytes_per_train_step")
     assert lower_is_better("payload_bytes_per_step")
     assert not lower_is_better("tiny_llama_train_tokens_per_sec_per_chip")
+    # ISSUE 19 direction pin: the bucketed backward's overlap window is
+    # higher-is-better — a SHRINKING overlap_fraction is the regression.
+    assert not lower_is_better("overlap_fraction")
 
     def row(metric, value):
         return json.dumps({"metric": metric, "value": value,
@@ -219,3 +222,19 @@ def test_bench_compare_direction_aware_gating(tmp_path):
         f.write(row("tps", 100.0) + "\n")
     _, regressions = compare([committed], slow, 20.0)
     assert len(regressions) == 1 and "below best" in regressions[0]
+
+    # overlap_fraction gates downward too: a shrinking overlap window
+    # (first hop waiting on more of the backward) is the regression.
+    committed2 = str(tmp_path / "BENCH_r02.json")
+    with open(committed2, "w") as f:
+        f.write(row("overlap_fraction", 0.8) + "\n")
+    shrunk = str(tmp_path / "cand_shrunk.json")
+    with open(shrunk, "w") as f:
+        f.write(row("overlap_fraction", 0.4) + "\n")
+    _, regressions = compare([committed2], shrunk, 20.0)
+    assert len(regressions) == 1 and "below best" in regressions[0]
+    grown = str(tmp_path / "cand_grown.json")
+    with open(grown, "w") as f:
+        f.write(row("overlap_fraction", 0.9) + "\n")
+    _, regressions = compare([committed2], grown, 20.0)
+    assert regressions == []
