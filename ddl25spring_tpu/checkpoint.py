@@ -73,7 +73,11 @@ class Checkpointer:
     restored at its SAVED shapes (replicated) and resharded into the
     template via ``parallel.dp.reshard_state`` (pad-swap with a hard error
     on non-zero truncated tails, never orbax's silent shape adaptation).
-    Counted in ``stats.ckpt_reshards``.
+    Counted in ``stats.ckpt_reshards``. When the template lives on a
+    ``(data, stage)`` mesh this includes a stage RE-PARTITION: a state
+    saved at (D, S) restores onto (D′, S′) via
+    ``parallel.pp.repartition_stage_state``'s global-coordinate-id remap
+    of the stage-sharded moments / EF residuals, same entry point.
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,

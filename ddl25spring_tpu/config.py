@@ -276,11 +276,17 @@ class ResilienceConfig:
     retry_base_delay: float = 0.1  # seconds; doubles per attempt, jittered
     faults: str = ""               # FaultPlan spec for injection runs
     fault_seed: int = 0            # drives every random fault choice
-    # Elastic data parallelism (resilience/elastic.py, DP trainer only):
-    # survive replica loss mid-run by draining at the chunk edge,
-    # re-meshing onto the survivors and resharding params + ZeRO-1
-    # optimizer state N→M. With zero faults the elastic loop's loss
-    # trajectory is bitwise the non-elastic one (tests/test_elastic.py).
+    # Elastic parallelism (resilience/elastic.py; DP, DP×PP, and DP×TP
+    # fused-dispatch trainers): survive device loss mid-run by draining
+    # at the chunk edge, re-meshing onto the survivors and resharding
+    # the state. On a DP×PP mesh the controller prefers dropping a data
+    # row; when the victim's stage column has no surviving replica it
+    # RE-PARTITIONS layers onto fewer stages (S→S′, S′ | n_layers) and
+    # re-slices the stage-sharded state by global coordinate id. On
+    # DP×TP only the data axis re-meshes (PSA activation EF residuals
+    # resize per data row); a model-axis loss is unrecoverable. With
+    # zero faults the elastic loop's loss trajectory is bitwise the
+    # non-elastic one (tests/test_elastic.py).
     elastic: bool = False
     # Host-RAM last-good state mirror cadence, in chunk edges: 1 mirrors
     # every edge (recovery replays nothing), k mirrors every k-th (cheaper

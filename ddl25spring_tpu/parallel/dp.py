@@ -600,13 +600,48 @@ def reshard_state(host_state, template_state):
     bucket pad-swaps) and the per-bucket 1-D gather residuals fall through
     to the flat-vector leaf rule.
 
+    Multi-axis templates route through dedicated pre-passes before the
+    leaf rule:
+
+    - a template living on a mesh WITH a ``stage`` axis is a DP×PP
+      overlap state — ``pp.repartition_stage_state`` rewrites the
+      ``(data, stage)`` stacks (ZeRO-1 moments, ring/gather EF residuals)
+      through topology-invariant global coordinate ids, handling stage
+      re-partition S→S′, data resize, or both at once. That pre-pass
+      REPLACES the flat-ring pre-pass below (the PP residuals are 3-D
+      ``[n, S, ·]`` stacks, not flat rings) and leaves every stack at the
+      template's exact shape, so the leaf rule is placement-only.
+    - a ``TPActState`` snapshot (the PSA activation-EF trainer) resizes
+      its ``act_residual`` ``[n_data, tp, L, 2, B, T, D]`` across a
+      data-axis resize by the row rule of ``_resize_ring_residual``:
+      per-shard batch is fixed, so surviving data rows copy bitwise,
+      new rows start at zero pending error, dropped rows die with their
+      shards. Any non-``data`` dimension changing is a named error.
+
     Value-exact by construction: every surviving coordinate is a bitwise
     copy, so a trajectory continued from the resharded state is the
     trajectory of a fresh M-way run initialized from the same snapshot
     (asserted in tests/test_elastic.py)."""
     from ..ops.adam import resize_zero_padded
 
-    if (hasattr(host_state, "ring_residual")
+    t_arrays = [x for x in jax.tree.leaves(template_state)
+                if isinstance(x, jax.Array)]
+    t_mesh = t_arrays[0].sharding.mesh if t_arrays else None
+    on_stage_mesh = (t_mesh is not None
+                     and "stage" in getattr(t_mesh, "axis_names", ()))
+    if on_stage_mesh:
+        from . import pp as _pp
+        host_state = _pp.repartition_stage_state(host_state, template_state)
+
+    if hasattr(host_state, "act_residual") and hasattr(
+            template_state, "act_residual"):
+        host_state = host_state._replace(
+            act_residual=_resize_act_residual(
+                np.asarray(host_state.act_residual),
+                tuple(template_state.act_residual.shape)))
+
+    if (not on_stage_mesh
+            and hasattr(host_state, "ring_residual")
             and hasattr(template_state, "ring_residual")):
         h_rr = host_state.ring_residual
         t_rr = template_state.ring_residual
@@ -685,6 +720,31 @@ def _resize_ring_residual(h: np.ndarray, new_shape) -> np.ndarray:
     for r in range(min(n_old, n_new)):
         out[r] = resize_zero_padded(np.asarray(h[r]), len_new)
         out[r, r * local_new:(r + 1) * local_new] = 0.0
+    return out
+
+
+def _resize_act_residual(h: np.ndarray, new_shape) -> np.ndarray:
+    """Resize a PSA ``act_residual`` [n_data, tp, L, 2, B, T, D] across a
+    data-axis resize. Row r is data-shard r's per-sub-layer pending
+    activation quantization error over its OWN fixed-size microbatch
+    (per-shard batch is constant across worlds — the global batch scales
+    with n), so the data dimension follows ``_resize_ring_residual``'s row
+    rule: surviving rows copy bitwise, new rows (grow) start at zero
+    pending error like a fresh shard's, dropped rows (shrink) die with
+    their shards' in-flight data. Every non-``data`` dimension is
+    topology-independent (tp layout, layer count, sub-layer pair, batch
+    geometry) — a mismatch there is a reconfiguration, not a resize, and
+    hard-errors by name."""
+    if h.shape[1:] != tuple(new_shape[1:]):
+        raise ValueError(
+            f"act_residual resize only moves the data axis: snapshot "
+            f"{h.shape} vs template {tuple(new_shape)} differ beyond "
+            f"dimension 0 — changing tp/layers/batch geometry across a "
+            f"re-mesh is not a resize")
+    n_new = int(new_shape[0])
+    out = np.zeros(tuple(new_shape), h.dtype)
+    n_keep = min(h.shape[0], n_new)
+    out[:n_keep] = h[:n_keep]
     return out
 
 

@@ -58,35 +58,124 @@ def make_mesh(axis_sizes: Optional[Dict[str, int]] = None, *,
     return Mesh(dev_array, tuple(names))
 
 
-def survivor_submesh(mesh: Mesh, lost: Sequence[int]) -> Mesh:
-    """The mesh that remains after losing data-axis replicas ``lost`` —
-    elastic DP's re-mesh step (resilience/elastic.py). Surviving devices
-    keep their relative order, so replica ``i`` of the new mesh is the
-    ``i``-th survivor of the old one.
-
-    Data-axis-only meshes for now: dropping a replica from a multi-axis
-    mesh (DPxPP, DPxTP) would orphan the lost replica's stage/model
-    partners, a genuinely different recovery problem (their shards are
-    intact and must be re-wired, not resharded)."""
-    for name, size in mesh.shape.items():
-        if name != "data" and size > 1:
+def _elastic_second_axis(mesh: Mesh, who: str) -> Optional[str]:
+    """The one non-``data`` axis an elastic re-mesh may carry along —
+    ``stage`` (DPxPP) or ``model`` (DPxTP) — or None for the classic
+    data-only mesh. Every other axis must be size 1, and composing BOTH a
+    real stage and a real model axis with elasticity is out of scope (one
+    non-data axis at a time)."""
+    names = mesh.axis_names
+    for name in names:
+        if name not in ("data", "stage", "model") and mesh.shape[name] > 1:
             raise ValueError(
-                f"survivor_submesh supports data-axis-only meshes; "
-                f"axis {name!r} has size {size}")
-    n = mesh.shape.get("data", 1)
+                f"{who} supports data/stage/model mesh axes only; "
+                f"axis {name!r} has size {mesh.shape[name]}")
+    if mesh.shape.get("stage", 1) > 1 and mesh.shape.get("model", 1) > 1:
+        raise ValueError(
+            f"{who}: a 3-axis (data x stage x model) mesh has no "
+            "supported survivor topology — elastic recovery composes "
+            "over one non-data axis at a time")
+    if "stage" in names:
+        return "stage"
+    if "model" in names:
+        return "model"
+    return None
+
+
+def _mesh_from_flat(mesh: Mesh, devices, n_data: int, second: Optional[str],
+                    second_size: int) -> Mesh:
+    """Rebuild a mesh with ``mesh``'s axis names from a flat (data-major)
+    device list, resizing ``data`` to ``n_data`` and the second axis to
+    ``second_size`` (every other axis stays at size 1)."""
+    if second is None:
+        return Mesh(np.asarray(devices), ("data",))
+    shape = tuple(n_data if a == "data"
+                  else (second_size if a == second else 1)
+                  for a in mesh.axis_names)
+    return Mesh(np.asarray(devices).reshape(shape), mesh.axis_names)
+
+
+def _largest_stage_divisor(n_layers: int, cap: int) -> int:
+    """The largest stage count ``S' <= cap`` with ``S' | n_layers`` — the
+    factorization choice of a layer re-partition. ``S' = 1`` always
+    qualifies, so this only fails on a non-positive cap."""
+    for s in range(min(int(cap), int(n_layers)), 0, -1):
+        if n_layers % s == 0:
+            return s
+    raise ValueError(f"no stage count <= {cap} divides n_layers={n_layers}")
+
+
+def survivor_submesh(mesh: Mesh, lost: Sequence[int],
+                     *, layer_divisor: Optional[int] = None) -> Mesh:
+    """The mesh that remains after losing devices ``lost`` — the elastic
+    re-mesh step (resilience/elastic.py). Surviving devices keep their
+    relative order, so replica ``i`` of the new mesh is the ``i``-th
+    survivor of the old one.
+
+    On a data-only mesh ``lost`` indexes replicas, exactly as before. On a
+    2-axis mesh — ``(data, stage)`` DPxPP or ``(data, model)`` DPxTP —
+    ``lost`` indexes the FLAT (data-major) device grid, and the survivor
+    topology is chosen per axis:
+
+    - **data shrink** (preferred): every victim's data row is dropped
+      whole; the victims' stage/model column partners in the surviving
+      rows are intact replicas of the same shards, so the recovery is a
+      pure reshard at the same stage/model count.
+    - **stage re-partition**: when NO complete data row survives, a
+      ``stage`` mesh falls back to re-partitioning layers over the
+      survivors — the new stage count is the largest ``S'`` that divides
+      ``layer_divisor`` (the model's ``n_layers``, required here — a
+      named error otherwise) and fits the surviving device count; the
+      remaining survivors fill ``S'``-wide data rows. A ``model`` mesh
+      has no such fallback (re-partitioning the Megatron column/row
+      layout is unsupported) and errors instead."""
+    second = _elastic_second_axis(mesh, "survivor_submesh")
+    n_data = mesh.shape.get("data", 1)
+    s2 = int(np.prod([s for a, s in mesh.shape.items() if a != "data"],
+                     dtype=int)) if second is not None else 1
+    total = n_data * s2
     lost = sorted(set(int(i) for i in lost))
-    if any(i < 0 or i >= n for i in lost):
-        raise ValueError(f"lost replicas {lost} out of range for data={n}")
-    if len(lost) >= n:
-        raise ValueError(f"losing {len(lost)} of {n} replicas leaves no "
+    if any(i < 0 or i >= total for i in lost):
+        noun = "replicas" if second is None else "devices"
+        raise ValueError(f"lost {noun} {lost} out of range for "
+                         f"{dict(mesh.shape)}")
+    if len(lost) >= total:
+        raise ValueError(f"losing {len(lost)} of {total} devices leaves no "
                          "survivors — nothing to re-mesh onto")
-    devices = [d for i, d in enumerate(mesh.devices.flatten())
-               if i not in lost]
-    return Mesh(np.asarray(devices), ("data",))
+    flat = list(mesh.devices.flatten())
+    if second is None:
+        devices = [d for i, d in enumerate(flat) if i not in lost]
+        return Mesh(np.asarray(devices), ("data",))
+    victim_rows = {i // s2 for i in lost}
+    surviving_rows = [r for r in range(n_data) if r not in victim_rows]
+    if surviving_rows:
+        devices = [flat[r * s2 + c] for r in surviving_rows
+                   for c in range(s2)]
+        return _mesh_from_flat(mesh, devices, len(surviving_rows),
+                               second, s2)
+    survivors = [d for i, d in enumerate(flat) if i not in lost]
+    if second == "model":
+        raise ValueError(
+            f"device loss left no complete data row of the "
+            f"{dict(mesh.shape)} mesh intact, and the model axis cannot "
+            "re-partition (the Megatron column/row layout is not "
+            "layer-sliced) — a model-axis loss is unrecoverable")
+    if layer_divisor is None:
+        raise ValueError(
+            "stage re-partition needs layer_divisor (the model's "
+            "n_layers) to choose a stage count S' with S' | n_layers — "
+            "pass it through ElasticController(layer_divisor=...)")
+    new_s = _largest_stage_divisor(int(layer_divisor),
+                                   min(len(survivors), s2))
+    new_d = len(survivors) // new_s
+    return _mesh_from_flat(mesh, survivors[:new_d * new_s], new_d,
+                           second, new_s)
 
 
 def rejoin_mesh(mesh: Mesh, returned: Sequence, *,
-                pool: Optional[Sequence] = None) -> Mesh:
+                pool: Optional[Sequence] = None,
+                pool_shape: Optional[Sequence[int]] = None,
+                layer_divisor: Optional[int] = None) -> Mesh:
     """The mesh after previously-lost devices come back — the scale-UP
     inverse of ``survivor_submesh`` (resilience/elastic.py's grow path).
 
@@ -98,14 +187,20 @@ def rejoin_mesh(mesh: Mesh, returned: Sequence, *,
     ``jax.devices()[:4]`` (the bitwise bar in tests/test_elastic.py).
     Without ``pool`` the returned devices append at the end.
 
-    Same data-axis-only restriction as ``survivor_submesh``, and rejoining
-    a device already in the mesh is a hard error (a duplicate device would
-    alias two replicas onto one chip and silently halve real throughput)."""
-    for name, size in mesh.shape.items():
-        if name != "data" and size > 1:
-            raise ValueError(
-                f"rejoin_mesh supports data-axis-only meshes; "
-                f"axis {name!r} has size {size}")
+    On a 2-axis mesh ``pool_shape`` is the run's ORIGINAL device-grid
+    shape: a full rejoin reshapes the pool-ordered devices straight back
+    into it, restoring the original ``(data, stage)`` factorization
+    device-for-device (a stage re-partition grows back to the original
+    stage count, the multi-axis pool-order bar). A PARTIAL rejoin on a
+    ``stage`` mesh re-runs the factorization choice (largest
+    ``S' | layer_divisor`` that fits, capped by the original stage
+    count); on a ``model`` mesh the model degree is fixed and the data
+    axis takes whole rows.
+
+    Rejoining a device already in the mesh is a hard error (a duplicate
+    device would alias two replicas onto one chip and silently halve real
+    throughput)."""
+    second = _elastic_second_axis(mesh, "rejoin_mesh")
     returned = list(returned)
     if not returned:
         raise ValueError("rejoin_mesh needs at least one returned device")
@@ -125,7 +220,35 @@ def rejoin_mesh(mesh: Mesh, returned: Sequence, *,
                              "pool — rejoin_mesh can only restore capacity "
                              "the run started with")
         devices = sorted(devices, key=lambda d: index[d])
-    return Mesh(np.asarray(devices), ("data",))
+    if second is None:
+        return Mesh(np.asarray(devices), ("data",))
+    if pool_shape is not None and len(devices) == int(np.prod(pool_shape)):
+        return Mesh(np.asarray(devices).reshape(tuple(pool_shape)),
+                    mesh.axis_names)
+    s2 = int(np.prod([s for a, s in mesh.shape.items() if a != "data"],
+                     dtype=int))
+    if second == "model":
+        new_s = s2                  # the Megatron degree never changes
+    else:
+        cap = s2
+        if pool_shape is not None:
+            # Partial rejoins never exceed the run's original stage count
+            # — the full-pool reshape above is the only path back to it.
+            axis_pos = mesh.axis_names.index("stage")
+            cap = int(pool_shape[axis_pos])
+        if layer_divisor is None:
+            raise ValueError(
+                "a partial rejoin onto a stage mesh re-runs the "
+                "factorization choice and needs layer_divisor (the "
+                "model's n_layers)")
+        new_s = _largest_stage_divisor(int(layer_divisor),
+                                       min(len(devices), cap))
+    new_d = len(devices) // new_s
+    if new_d < 1:
+        raise ValueError(f"{len(devices)} devices cannot host a "
+                         f"{second}={new_s} mesh")
+    return _mesh_from_flat(mesh, devices[:new_d * new_s], new_d,
+                           second, new_s)
 
 
 def axis_size(mesh: Mesh, name: str) -> int:

@@ -42,6 +42,7 @@ from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -954,6 +955,359 @@ def _pp_overlap_setup(optimizer, mesh: Mesh, params, wire: str,
     else:
         state_specs = TrainState(specs, opt_specs, P())
     return state, state_specs, n, pad, local, total, bm
+
+
+def _stage_coord_ids(params, n: int, n_stages: int, comm_buckets: int):
+    """Global-coordinate id layout of the DP×PP flat state space: for each
+    stage ``s`` and ring bucket ``b``, the int64 array mapping every slot of
+    the ``[n·sizes[b]]`` bucket vector (data-row-major: row ``r`` owns slots
+    ``[r·sizes[b], (r+1)·sizes[b])``) to a unique id over the GLOBAL param
+    coordinates, with ``-1`` marking pad slots. Ids are assigned in tree
+    order over the global leaves; a stage's block slice maps to the
+    contiguous ``[s·gsz/S, (s+1)·gsz/S)`` range of its leaf's ravel (the
+    blocked layer layout), and stage-replicated leaves (embed/head/
+    final-norm) share one id range across stages.
+
+    This is the coordinate system ``repartition_stage_state`` reshards
+    through: a value's id is topology-invariant, so gathering an old
+    ``(n, S)`` stack by id and re-reading it at ``(n', S')`` is a bitwise
+    per-coordinate copy whatever moved — the data world, the stage count,
+    or both. Returns ``(ids, sizes, total_coords)`` with ``ids[s][b]`` the
+    per-(stage, bucket) map and ``sizes`` the per-shard bucket sizes."""
+    from .compress import make_bucket_map
+
+    entries = jax.tree_util.tree_flatten_with_path(params)[0]
+    bases, metas = [], []
+    off = 0
+    for path, leaf in entries:
+        key = getattr(path[0], "key", None) if path else None
+        gsz = int(np.prod(np.shape(leaf), dtype=int))
+        is_block = key == "blocks"
+        if is_block and gsz % n_stages:
+            raise ValueError(f"blocks leaf of {gsz} elements does not "
+                             f"split over {n_stages} stages")
+        bases.append(off)
+        metas.append((is_block, gsz, gsz // n_stages if is_block else gsz))
+        off += gsz
+    total_coords = off
+
+    def local_ids(s):
+        out = []
+        for base, (is_block, gsz, lsz) in zip(bases, metas):
+            start = base + s * lsz if is_block else base
+            out.append(np.arange(start, start + lsz, dtype=np.int64))
+        return out
+
+    B = int(comm_buckets)
+    if B == 1:
+        total = sum(lsz for _, _, lsz in metas)
+        pad = (-total) % n
+        sizes = ((total + pad) // n,)
+        ids = [[np.concatenate(local_ids(s)
+                               + [np.full((pad,), -1, np.int64)])]
+               for s in range(n_stages)]
+        return ids, sizes, total_coords
+
+    def leaf_local(path, leaf):
+        key = getattr(path[0], "key", None) if path else None
+        if key == "blocks":
+            return (int(np.prod(np.shape(leaf), dtype=int)) // n_stages,
+                    int(np.shape(leaf)[0]) // n_stages)
+        return int(np.prod(np.shape(leaf), dtype=int)), None
+
+    bm = make_bucket_map(params, n, B, leaf_local=leaf_local)
+    ids = []
+    for s in range(n_stages):
+        lids = local_ids(s)
+        per_bucket = []
+        for b, pieces in enumerate(bm.pieces):
+            parts = [lids[li][st:st + sz] for li, st, sz in pieces]
+            if b == bm.nbuckets - 1 and bm.pad:
+                parts.append(np.full((bm.pad,), -1, np.int64))
+            per_bucket.append(np.concatenate(parts))
+        ids.append(per_bucket)
+    return ids, bm.sizes, total_coords
+
+
+def repartition_stage_state(host_state, template_state):
+    """Stage re-partition / data reshard of a DP×PP overlap-state host
+    snapshot: rewrite the ``(data, stage)``-stacked ZeRO-1 moments
+    (``[n, S, local]``, per-bucket tuples under ``comm_buckets > 1``), ring
+    EF residuals (``[n, S, n·local]``) and gather residuals
+    (``[n, S, local]``) from the snapshot's ``(n, S)`` topology to the
+    template's ``(n', S')`` — S may change (layer re-partition after a
+    stage loss), n may change (data-axis shrink/grow on the DP×PP mesh),
+    or both. Equal-shape leaves — global params (``blocks`` keeps its
+    ``[n_layers, ...]`` shape at ANY stage count), per-leaf moments of the
+    gradient-aggregation path, scalars — pass through untouched for
+    ``reshard_state``'s placement rule.
+
+    Mechanism: every state coordinate gets a topology-invariant global id
+    (``_stage_coord_ids``); the old stacks scatter by id into one global
+    vector per (row, bucket) and the new stacks gather back — a bitwise
+    per-coordinate copy, the stage-axis generalization of
+    ``dp._resize_ring_residual``'s pad swap. Conventions carried over from
+    the data-only path: values in pad slots must be exactly zero (hard
+    error, never silent truncation); ring rows beyond the new data world
+    are dropped with their shards, new rows start at zero error, and each
+    surviving row's own-chunk slot re-zeros in the new geometry.
+    Stage-replicated leaves (embed/head/final-norm) carry identical
+    moments on every stage (their gradients are stage-psum'd), so the
+    by-id overwrite is value-stable; ring residuals there keep the
+    highest surviving stage's pending error (deterministic — both
+    recovery paths and the fresh-run comparison all route through here).
+
+    Named errors: bucket-count mismatches (rebucketing a live EF state is
+    undefined), an interleaved layout across a stage-count change (the
+    chunked layer order breaks the blocked-slice id map), a model axis in
+    the template mesh (DP×PP×TP elastic is out of scope), and an ``S'``
+    that does not divide ``n_layers``."""
+    t_arrays = [x for x in jax.tree.leaves(template_state)
+                if isinstance(x, jax.Array)]
+    if not t_arrays:
+        return host_state
+    mesh = t_arrays[0].sharding.mesh
+    if mesh.shape.get("model", 1) > 1:
+        raise ValueError(
+            "elastic re-mesh of the DP×PP×TP overlap state is unsupported "
+            "— the (data, stage, model) stacks have no reshard rule; run "
+            "elastic DP×PP at model=1")
+    n_new = int(mesh.shape.get("data", 1))
+    s_new = int(mesh.shape["stage"])
+
+    def _stacks(state):
+        """(opt vector stacks, ring tuple, gather tuple) — tuples
+        normalized to per-bucket lists; None where the field is absent."""
+        ring = getattr(state, "ring_residual", None)
+        gather = getattr(state, "gather_residual", None)
+        as_list = (lambda x: None if x is None
+                   else (list(x) if isinstance(x, tuple) else [x]))
+        return as_list(ring), as_list(gather)
+
+    h_ring, h_gather = _stacks(host_state)
+    t_ring, t_gather = _stacks(template_state)
+    if (h_ring is None) != (t_ring is None) or (
+            h_ring is not None and len(h_ring) != len(t_ring)):
+        raise ValueError(
+            f"comm_buckets mismatch: the snapshot carries "
+            f"{len(h_ring) if h_ring else 0} EF residual bucket(s), the "
+            f"template {len(t_ring) if t_ring else 0} — rebucketing a "
+            "live EF state is not defined; rebuild the trainer with the "
+            "snapshot's comm_buckets")
+
+    # The snapshot's (n, S) topology, read off the stacked leaves whose
+    # shapes DIFFER from the template's. Shape-equal 3-D leaves must pass
+    # through untouched — the gradient-aggregation path's param-shaped
+    # moments (blocks [L, d, d]) are global arrays, not stacks — so only
+    # mismatched pairs identify the (data, stage) stacks to rewrite.
+    pairs = set()
+
+    def _note(h, t):
+        hs, ts = tuple(np.shape(h)), tuple(np.shape(t))
+        if len(hs) == 3 and len(ts) == 3 and hs != ts:
+            pairs.add((hs[:2], ts[:2]))
+
+    jax.tree.map(_note, host_state.opt_state, template_state.opt_state)
+    for h, t in zip(h_ring or [], t_ring or []):
+        _note(h, t)
+    for h, t in zip(h_gather or [], t_gather or []):
+        _note(h, t)
+    if not pairs:
+        return host_state       # same topology: placement-only reshard
+    olds = {o for o, _ in pairs}
+    news = {t for _, t in pairs}
+    if len(olds) != 1 or len(news) != 1:
+        raise ValueError(
+            f"inconsistent (data, stage) stack topologies across the "
+            f"snapshot/template state: {sorted(olds)} -> {sorted(news)} — "
+            "the stacks of one overlap state must share one layout")
+    (n_old, s_old), = olds
+    n_old, s_old = int(n_old), int(s_old)
+    if next(iter(news)) != (n_new, s_new):
+        raise ValueError(
+            f"template stacks are laid out {next(iter(news))} but its "
+            f"mesh is (data={n_new}, stage={s_new}) — not a DP×PP "
+            "overlap template")
+
+    params = host_state.params
+    blocks = params.get("blocks", {})
+    n_layers = int(np.shape(jax.tree.leaves(blocks)[0])[0]) if blocks else 0
+    for s, tag in ((s_old, "snapshot"), (s_new, "template")):
+        if n_layers and n_layers % s:
+            raise ValueError(
+                f"stage re-partition: the {tag}'s stage count {s} does "
+                f"not divide n_layers={n_layers} — layers shard as equal "
+                "[n_layers/S] blocks, so S' must divide n_layers")
+    if s_old != s_new and _LAYOUT_KEY in params:
+        raise ValueError(
+            "stage re-partition of an interleaved layout is unsupported: "
+            "the chunk-major layer order breaks the blocked [L/S] stage "
+            "slices the re-partition re-slices — run elastic PP with "
+            "schedule='gpipe' or '1f1b'")
+
+    # Bucket structure. The ring-bucket count splits every per-shard flat
+    # slice into per-bucket stacks, and the ZeRO-1 opt tree mirrors it as
+    # a TOP-LEVEL tuple of per-bucket optax states. With EF residuals the
+    # count is the residual tuple's; without them a bucketed opt tuple
+    # must be told apart from a single optax state (which is itself a
+    # tuple) — done by checking which bucket geometry actually explains
+    # the mismatched stack sizes.
+    def _mismatch_dims(opt, t_opt):
+        dims = []
+
+        def leaf(x, t):
+            hs, ts = tuple(np.shape(x)), tuple(np.shape(t))
+            if len(hs) == 3 and hs != ts:
+                dims.append(int(hs[2]))
+
+        jax.tree.map(leaf, opt, t_opt)
+        return dims
+
+    def _explains(nb_try):
+        try:
+            sizes = _stage_coord_ids(params, n_old, s_old, nb_try)[1]
+        except ValueError:
+            return False
+        if nb_try == 1:
+            return all(d == sizes[0]
+                       for d in _mismatch_dims(host_state.opt_state,
+                                               template_state.opt_state))
+        if not (isinstance(host_state.opt_state, tuple)
+                and len(host_state.opt_state) == nb_try):
+            # nb buckets but no per-bucket opt tuple: legal only when the
+            # opt tree has no stacks at all (gradient aggregation keeps
+            # param-shaped global moments).
+            return not _mismatch_dims(host_state.opt_state,
+                                      template_state.opt_state)
+        return all(d == sizes[b]
+                   for b in range(nb_try)
+                   for d in _mismatch_dims(host_state.opt_state[b],
+                                           template_state.opt_state[b]))
+
+    if h_ring is not None:
+        nb = len(h_ring)
+        if not _explains(nb):
+            raise ValueError(
+                f"DP×PP overlap snapshot does not match its own bucket "
+                f"geometry ({nb} bucket(s) at data={n_old}, "
+                f"stage={s_old}) — refusing to re-partition")
+    else:
+        cands = [1] + ([len(host_state.opt_state)]
+                       if isinstance(host_state.opt_state, tuple) else [])
+        nb = next((c for c in cands if _explains(c)), None)
+        if nb is None:
+            raise ValueError(
+                "cannot infer the bucket structure of the DP×PP ZeRO-1 "
+                "stacks — the mismatched stack sizes fit neither a "
+                "single-bucket nor a per-bucket tuple layout")
+    opt_bucketed = (nb > 1 and isinstance(host_state.opt_state, tuple)
+                    and len(host_state.opt_state) == nb
+                    and bool(_mismatch_dims(host_state.opt_state,
+                                            template_state.opt_state)))
+    ids_old, sizes_old, total_coords = _stage_coord_ids(
+        params, n_old, s_old, nb)
+    ids_new, sizes_new, _ = _stage_coord_ids(params, n_new, s_new, nb)
+
+    def _scatter(g, vals, ids, what):
+        pad = ids < 0
+        if np.any(vals[pad] != 0):
+            raise ValueError(
+                f"nonzero {what} values in the flat pad tail — the "
+                "snapshot does not look like a zero-padded DP×PP stack")
+        g[ids[~pad]] = vals[~pad]
+
+    # A coordinate's bucket changes with the topology (bucket boundaries
+    # are carved out of the per-stage LOCAL geometry), so a field's
+    # buckets pool into ONE global id-indexed vector before the new
+    # layout gathers back — a per-bucket-independent remap would drop
+    # every coordinate that migrated buckets.
+    def _stacks_to_global(stacks, what):
+        g = None
+        for b, h in enumerate(stacks):
+            h = np.asarray(h)
+            if h.shape != (n_old, s_old, sizes_old[b]):
+                raise ValueError(
+                    f"{what} stack has shape {h.shape}, expected "
+                    f"{(n_old, s_old, sizes_old[b])}")
+            if g is None:
+                g = np.zeros((total_coords,), h.dtype)
+            for s in range(s_old):
+                _scatter(g, np.ascontiguousarray(h[:, s]).reshape(-1),
+                         ids_old[s][b], what)
+        return g
+
+    def _global_to_stacks(g, dtype):
+        out = []
+        for b in range(nb):
+            ob = np.zeros((n_new, s_new, sizes_new[b]), dtype)
+            for s2 in range(s_new):
+                ids = ids_new[s2][b]
+                vals = np.where(ids >= 0, g[np.clip(ids, 0, None)], 0)
+                ob[:, s2] = vals.reshape(n_new, sizes_new[b]).astype(dtype)
+            out.append(ob)
+        return out
+
+    def _remap_field(stacks, what):
+        g = _stacks_to_global(stacks, what)
+        return _global_to_stacks(g, np.asarray(stacks[0]).dtype)
+
+    def _remap_ring_field(rings):
+        dtype = np.asarray(rings[0]).dtype
+        outs = [np.zeros((n_new, s_new, n_new * sizes_new[b]), dtype)
+                for b in range(nb)]
+        for r in range(min(n_old, n_new)):
+            g = np.zeros((total_coords,), dtype)
+            for b, h in enumerate(rings):
+                h = np.asarray(h)
+                if h.shape != (n_old, s_old, n_old * sizes_old[b]):
+                    raise ValueError(
+                        f"ring_residual stack has shape {h.shape}, "
+                        f"expected "
+                        f"{(n_old, s_old, n_old * sizes_old[b])}")
+                for s in range(s_old):
+                    _scatter(g, h[r, s], ids_old[s][b], "ring_residual")
+            for b in range(nb):
+                for s2 in range(s_new):
+                    ids = ids_new[s2][b]
+                    outs[b][r, s2] = np.where(ids >= 0,
+                                              g[np.clip(ids, 0, None)], 0)
+                # The owner never quantizes its own chunk — structurally
+                # zero, but the chunk boundaries moved with (n', S').
+                outs[b][r, :,
+                        r * sizes_new[b]:(r + 1) * sizes_new[b]] = 0.0
+        return outs
+
+    def _remap_opt_tree(opts, t_opts):
+        """Remap the stacked leaves of per-bucket same-treedef opt states
+        jointly (leaf j of bucket b is one field's bucket-b stack)."""
+        flat = [jax.tree_util.tree_flatten(o) for o in opts]
+        t_flat = [jax.tree_util.tree_flatten(o)[0] for o in t_opts]
+        leaves = [list(f[0]) for f in flat]
+        for j in range(len(leaves[0])):
+            hs = tuple(np.shape(leaves[0][j]))
+            ts = tuple(np.shape(t_flat[0][j]))
+            if len(hs) == 3 and hs != ts:
+                outs = _remap_field([leaves[b][j] for b in range(nb)],
+                                    "opt_state")
+                for b in range(nb):
+                    leaves[b][j] = outs[b]
+        return [jax.tree_util.tree_unflatten(flat[b][1], leaves[b])
+                for b in range(nb)]
+
+    if opt_bucketed:
+        new_opt = tuple(_remap_opt_tree(list(host_state.opt_state),
+                                        list(template_state.opt_state)))
+    else:
+        new_opt = _remap_opt_tree([host_state.opt_state],
+                                  [template_state.opt_state])[0]
+    host_state = host_state._replace(opt_state=new_opt)
+    if h_ring is not None:
+        ring = _remap_ring_field(h_ring)
+        gather = _remap_field(h_gather, "gather_residual")
+        host_state = host_state._replace(
+            ring_residual=tuple(ring) if len(ring) > 1 else ring[0],
+            gather_residual=tuple(gather) if len(gather) > 1 else gather[0])
+    return host_state
 
 
 def _make_pp_overlap_local_step(cfg: LlamaConfig, optimizer, body: Callable,

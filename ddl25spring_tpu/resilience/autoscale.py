@@ -34,9 +34,13 @@ than lucky:
 
 ``Autoscaler.tick`` is a pure policy step: it reads one measurement and
 returns a ``ScaleDecision`` (or None). It never touches the trainer or
-the fleet — the caller wires decisions into ``train_llm_dp``'s
-``scale_hook`` and ``ServingFleet.set_active``
-(experiments/autoscale_smoke.py is the reference wiring). Keeping the
+the fleet — the caller wires decisions into the trainer's
+``scale_hook`` (train_llm_dp/_pp/_tp all take one) and
+``ServingFleet.set_active`` (experiments/autoscale_smoke.py is the
+reference wiring). On a multi-axis mesh ``train_world`` counts DATA
+rows: ``ElasticController.resize`` grows/shrinks the data axis only, so
+a PP trainer at (D, S) moves S devices per data row and a planned
+resize never re-partitions stages. Keeping the
 loop mechanism-free means it is trivially deterministic: same
 measurement sequence -> same decision sequence, which is what lets the
 smoke pin its scale trajectory.

@@ -47,12 +47,20 @@ shrink (or an M→N grow) the continued trajectory equals a fresh M- (N-)
 replica run restored from the same state — both directions, both
 recovery paths.
 
-Scope: DP-only meshes (gradient / zero1 aggregation — plus the int8-ring
-overlap drivers, whose EF residual trees reshard alongside the ZeRO-1
-moments via ``reshard_state``'s ring-residual pre-pass). Losing a replica
-from a DPxPP/DPxTP mesh orphans the victim's stage/model partners — a
-re-wiring problem, not a resharding one — and is rejected loudly
-(``parallel.mesh.survivor_submesh``).
+Scope: data-only meshes (gradient / zero1 aggregation — plus the
+int8-ring overlap drivers, whose EF residual trees reshard alongside the
+ZeRO-1 moments via ``reshard_state``'s ring-residual pre-pass), DP×PP
+meshes (the pipeline trainer's overlap drivers — victims index the flat
+2-D device grid, and ``survivor_submesh`` prefers dropping the victims'
+data rows whole; when no complete row survives, layers RE-PARTITION over
+the survivors at the largest stage count dividing ``n_layers``, and
+``pp.repartition_stage_state`` rewrites the ``(data, stage)`` moment/EF
+stacks through topology-invariant coordinate ids), and the TP trainer's
+PSA activation-EF state across data-axis resizes (the ``act_residual``
+row rule). A ``model``-axis loss remains unrecoverable — the Megatron
+column/row layout is not layer-sliced — and is rejected loudly
+(``parallel.mesh.survivor_submesh``), as is a 3-axis data×stage×model
+mesh.
 """
 
 from __future__ import annotations
@@ -75,7 +83,7 @@ class RemeshRecord:
     detected_at: int       # stream position of the interrupted dispatch
     resume_step: int       # stream position training resumed from
     dispatch: int          # absolute dispatch index of the interruption
-    old_world: int
+    old_world: int         # TOTAL device count (== data world on DP meshes)
     new_world: int
     lost: List[int] = field(default_factory=list)
     path: str = "mirror"   # "mirror" (host-RAM fast path) | "checkpoint"
@@ -83,6 +91,12 @@ class RemeshRecord:
     steps_replayed: int = 0  # detected_at - resume_step (re-trained steps)
     direction: str = "shrink"   # "shrink" | "grow"
     returned: List[int] = field(default_factory=list)  # rejoined pool slots
+    # Which mesh axis the re-mesh moved ("data" reshard vs "stage"
+    # re-partition) and the (D, S) factorization either side of it — the
+    # DP×PP accounting. On a data-only mesh: axis="data", shapes (D, 1).
+    axis: str = "data"
+    old_shape: Tuple[int, int] = (0, 1)
+    new_shape: Tuple[int, int] = (0, 1)
 
     def as_dict(self) -> dict:
         return {"detected_at": self.detected_at,
@@ -93,7 +107,10 @@ class RemeshRecord:
                 "seconds": self.seconds,
                 "steps_replayed": self.steps_replayed,
                 "direction": self.direction,
-                "returned": list(self.returned)}
+                "returned": list(self.returned),
+                "axis": self.axis,
+                "old_shape": list(self.old_shape),
+                "new_shape": list(self.new_shape)}
 
 
 class Resume(NamedTuple):
@@ -138,13 +155,21 @@ class ElasticController:
 
     def __init__(self, mesh, *, build: Callable, rewrap: Callable,
                  make_batches: Callable, ckpt=None, mirror_every: int = 1,
+                 layer_divisor: Optional[int] = None,
                  stats=None, telemetry=None, log_fn: Callable = print):
         self.mesh = mesh
         # The run's original full device pool: the grow path can only
         # restore capacity the run started with, and pool order is what
         # makes a full shrink-then-grow round trip land devices back in
-        # their original replica slots (the 4→3→4 bitwise bar).
+        # their original replica slots (the 4→3→4 bitwise bar). On a
+        # DP×PP mesh the pool SHAPE is the original (D, S) factorization a
+        # full rejoin reshapes straight back into, and ``layer_divisor``
+        # (the model's n_layers) is what the stage re-partition's
+        # factorization choice divides.
         self._pool = list(mesh.devices.flatten())
+        self._pool_shape = tuple(int(s) for s in mesh.devices.shape)
+        self._layer_divisor = (int(layer_divisor)
+                               if layer_divisor is not None else None)
         self._build = build
         self._rewrap = rewrap
         self._make_batches = make_batches
@@ -197,6 +222,17 @@ class ElasticController:
         current = set(self.mesh.devices.flatten())
         return [i for i, d in enumerate(self._pool) if d not in current]
 
+    @staticmethod
+    def _dxs(mesh) -> Tuple[int, int]:
+        """A mesh's (data, non-data) factorization — (D, S) on DP×PP,
+        (D, 1) on a data-only mesh."""
+        d = int(mesh.shape.get("data", 1))
+        s = 1
+        for a, sz in mesh.shape.items():
+            if a != "data":
+                s *= int(sz)
+        return d, s
+
     def recover(self, err: ReplicaLossError, *, failed_at: int,
                 dispatch: int) -> Resume:
         """Re-mesh onto the survivors and hand back a resumable world.
@@ -205,10 +241,16 @@ class ElasticController:
         (its first step index); ``dispatch`` its absolute dispatch index —
         the rebuilt fault wrapper continues the schedule from
         ``dispatch + 1``, so already-delivered faults never re-fire and
-        later-scheduled ones keep their absolute positions."""
+        later-scheduled ones keep their absolute positions.
+
+        Victims index the FLAT (data-major) device grid — on a data-only
+        mesh that is the replica index exactly as before; on DP×PP device
+        ``i`` is stage ``i % S`` of data row ``i // S``, and
+        ``survivor_submesh`` picks the survivor topology (data row-drop
+        when possible, else layer re-partition)."""
         from ..parallel.mesh import survivor_submesh
 
-        old_world = int(self.mesh.shape["data"])
+        old_world = int(self.mesh.devices.size)
         lost = err.victims(old_world)
         if not lost:
             # A 1-replica world has no survivors to re-mesh onto (victims'
@@ -216,9 +258,18 @@ class ElasticController:
             # run, and pretending otherwise would be a vacuous "recovery"
             # onto the dead replica itself.
             raise err
-        new_mesh = survivor_submesh(self.mesh, lost)
+        try:
+            new_mesh = survivor_submesh(self.mesh, lost,
+                                        layer_divisor=self._layer_divisor)
+        except ValueError as e:
+            # No recoverable survivor topology (e.g. a model-axis loss, or
+            # no stage count divides n_layers): the loss kills the run,
+            # same contract as the 1-replica case — re-raise the ORIGINAL
+            # fault with the topology verdict chained for the postmortem.
+            raise err from e
         self._log(f"replica loss at step {failed_at} (dispatch {dispatch}): "
                   f"lost {lost} of {old_world}; re-meshing onto "
+                  f"{int(new_mesh.devices.size)} of the "
                   f"{old_world - len(lost)} survivors")
         return self._remesh(new_mesh, failed_at=failed_at, dispatch=dispatch,
                             lost=lost, returned=[], direction="shrink",
@@ -234,7 +285,7 @@ class ElasticController:
         run restored from the same state)."""
         from ..parallel.mesh import rejoin_mesh
 
-        old_world = int(self.mesh.shape["data"])
+        old_world = int(self.mesh.devices.size)
         absent = self.absent()
         arrivals = sig.arrivals(absent)
         if not arrivals:
@@ -243,10 +294,12 @@ class ElasticController:
                 f"absent (world {old_world}, pool {len(self._pool)}) — a "
                 "return must follow a loss; fix the chaos spec") from sig
         returned = [self._pool[i] for i in arrivals]
-        new_mesh = rejoin_mesh(self.mesh, returned, pool=self._pool)
+        new_mesh = rejoin_mesh(self.mesh, returned, pool=self._pool,
+                               pool_shape=self._pool_shape,
+                               layer_divisor=self._layer_divisor)
         self._log(f"replica return at step {failed_at} "
                   f"(dispatch {dispatch}): pool slots {arrivals} rejoin; "
-                  f"re-meshing onto {old_world + len(arrivals)} replicas")
+                  f"re-meshing onto {int(new_mesh.devices.size)} devices")
         return self._remesh(new_mesh, failed_at=failed_at, dispatch=dispatch,
                             lost=[], returned=arrivals, direction="grow",
                             err=sig)
@@ -264,13 +317,20 @@ class ElasticController:
         ``at_step``: it is snapshotted as the mirror HERE, so the resize
         resumes from exactly this position — zero steps replayed, zero
         lost — regardless of the mirror cadence. Call only between
-        dispatches (the drain-at-chunk-edge contract)."""
+        dispatches (the drain-at-chunk-edge contract).
+
+        ``new_world`` targets the DATA axis: on a data-only mesh that is
+        the replica count exactly as before; on DP×PP a shrink releases
+        the highest data ROWS whole (S devices each, the pure-reshard
+        path — a planned resize never re-partitions layers) and a grow
+        reclaims ``Δ·S`` absent pool slots lowest-first."""
         from ..parallel import dp
         from ..parallel.mesh import rejoin_mesh, survivor_submesh
 
-        old_world = int(self.mesh.shape["data"])
+        old_data, s2 = self._dxs(self.mesh)
+        old_world = int(self.mesh.devices.size)
         new_world = int(new_world)
-        if new_world == old_world:
+        if new_world == old_data:
             return None
         # A capacity change is planned, not a failure: the just-drained
         # state IS last-good, and pinning the mirror at the edge makes
@@ -279,33 +339,40 @@ class ElasticController:
         if new_world < 1:
             raise ValueError(f"resize to {new_world} replicas: the training "
                              "mesh cannot shrink below 1")
-        if new_world > len(self._pool):
-            raise ValueError(f"resize to {new_world} replicas exceeds the "
-                             f"run's device pool ({len(self._pool)})")
-        if new_world < old_world:
-            lost = list(range(new_world, old_world))
-            new_mesh = survivor_submesh(self.mesh, lost)
-            self._log(f"resize at step {at_step}: releasing replicas "
-                      f"{lost} ({old_world} -> {new_world})")
+        if new_world * s2 > len(self._pool):
+            raise ValueError(f"resize to {new_world} data rows of {s2} "
+                             f"device(s) exceeds the run's device pool "
+                             f"({len(self._pool)})")
+        if new_world < old_data:
+            # Flat indices of the released rows (row r spans [r·S, (r+1)·S)).
+            lost = list(range(new_world * s2, old_data * s2))
+            new_mesh = survivor_submesh(self.mesh, lost,
+                                        layer_divisor=self._layer_divisor)
+            self._log(f"resize at step {at_step}: releasing data rows "
+                      f"{list(range(new_world, old_data))} "
+                      f"({old_data} -> {new_world})")
             return self._remesh(new_mesh, failed_at=at_step,
                                 dispatch=dispatch, lost=lost, returned=[],
                                 direction="shrink",
                                 err=RuntimeError(
-                                    f"resize {old_world}->{new_world} at "
+                                    f"resize {old_data}->{new_world} at "
                                     f"step {at_step} found no recoverable "
                                     "state (no mirror, no checkpoint)"))
-        arrivals = self.absent()[:new_world - old_world]
-        if len(arrivals) < new_world - old_world:
-            raise ValueError(f"resize to {new_world} replicas: only "
-                             f"{len(arrivals)} pool slots are absent")
+        arrivals = self.absent()[:(new_world - old_data) * s2]
+        if len(arrivals) < (new_world - old_data) * s2:
+            raise ValueError(f"resize to {new_world} data rows: only "
+                             f"{len(arrivals)} pool slots are absent "
+                             f"(need {(new_world - old_data) * s2})")
         returned = [self._pool[i] for i in arrivals]
-        new_mesh = rejoin_mesh(self.mesh, returned, pool=self._pool)
+        new_mesh = rejoin_mesh(self.mesh, returned, pool=self._pool,
+                               pool_shape=self._pool_shape,
+                               layer_divisor=self._layer_divisor)
         self._log(f"resize at step {at_step}: pool slots {arrivals} "
-                  f"rejoin ({old_world} -> {new_world})")
+                  f"rejoin ({old_data} -> {new_world})")
         return self._remesh(new_mesh, failed_at=at_step, dispatch=dispatch,
                             lost=[], returned=arrivals, direction="grow",
                             err=RuntimeError(
-                                f"resize {old_world}->{new_world} at step "
+                                f"resize {old_data}->{new_world} at step "
                                 f"{at_step} found no recoverable state "
                                 "(no mirror, no checkpoint)"))
 
@@ -319,13 +386,19 @@ class ElasticController:
         from ..parallel import dp
 
         t0 = time.perf_counter()
-        old_world = int(self.mesh.shape["data"])
-        new_world = int(new_mesh.shape["data"])
+        old_shape = self._dxs(self.mesh)
+        new_shape = self._dxs(new_mesh)
+        old_world = int(self.mesh.devices.size)
+        new_world = int(new_mesh.devices.size)
+        new_data = new_shape[0]
+        # Which axis moved: a stage-count change is a layer re-partition,
+        # anything else is a data-axis reshard (row drop / rejoin).
+        axis = "stage" if new_shape[1] != old_shape[1] else "data"
         self._beat(failed_at, "remesh")
         rroot = (self._tracer.start("remesh", trace="train", it=failed_at,
                                     old_world=old_world,
                                     new_world=new_world,
-                                    direction=direction)
+                                    axis=axis, direction=direction)
                  if self._tracer is not None else None)
 
         def _span(name):
@@ -364,7 +437,7 @@ class ElasticController:
                                 overwrite=True)
 
         with _span("replay"):
-            batches = self._make_batches(new_world)
+            batches = self._make_batches(new_data)
             last_beat = 0.0
             for i in range(resume_step):    # stream replay at the new width
                 next(batches)
@@ -387,7 +460,8 @@ class ElasticController:
             dispatch=dispatch, old_world=old_world, new_world=new_world,
             lost=lost, path=path, seconds=time.perf_counter() - t0,
             steps_replayed=failed_at - resume_step,
-            direction=direction, returned=returned)
+            direction=direction, returned=returned,
+            axis=axis, old_shape=old_shape, new_shape=new_shape)
         self.records.append(rec)
         if self._stats is not None:
             self._stats.remeshes += 1
@@ -396,11 +470,16 @@ class ElasticController:
                 old_world=old_world, new_world=new_world, lost=lost,
                 path=path, it=resume_step, detected_at=failed_at,
                 seconds=rec.seconds, steps_replayed=rec.steps_replayed,
-                direction=direction, returned=returned)
+                direction=direction, returned=returned,
+                axis=axis, old_shape=list(old_shape),
+                new_shape=list(new_shape))
+        shapes = (f" [{old_shape[0]}x{old_shape[1]} -> "
+                  f"{new_shape[0]}x{new_shape[1]} on the {axis} axis]"
+                  if old_shape[1] > 1 or new_shape[1] > 1 else "")
         self._log(f"re-mesh ({direction}) complete in {rec.seconds:.3f}s "
-                  f"via {path}: resuming at step {resume_step} "
+                  f"via {path}{shapes}: resuming at step {resume_step} "
                   f"({rec.steps_replayed} steps to re-train)")
-        return Resume(new_mesh, new_world, state, step_fn, window_shard,
+        return Resume(new_mesh, new_data, state, step_fn, window_shard,
                       batches, resume_step, rec)
 
     def _beat(self, step: int, phase: str) -> None:

@@ -77,10 +77,15 @@ class ReplicaLossError(RuntimeError):
     without one it propagates and kills the run, which is exactly today's
     non-elastic behavior.
 
-    ``victims(n)`` picks WHICH of the ``n`` current replicas died — a
+    ``victims(n)`` picks WHICH of the ``n`` current devices died — a
     seeded deterministic choice (same (seed, step) → same victims, the
     FaultPlan determinism contract), always leaving at least one survivor.
-    """
+    On a data-only mesh ``n`` is the replica count (the original
+    contract, bit-for-bit); on a DP×PP mesh the controller passes the
+    TOTAL device count and index ``i`` is stage ``i % S`` of data row
+    ``i // S`` — the flat data-major grid ``survivor_submesh`` consumes,
+    so a victim can orphan a stage column and force a layer
+    re-partition."""
 
     def __init__(self, step: int, count: int = 1, seed: int = 0):
         super().__init__(f"replica loss at dispatch {step} "

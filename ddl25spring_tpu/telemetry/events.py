@@ -101,7 +101,10 @@ _REQUIRED: Dict[str, tuple] = {
     # Elastic re-mesh recovery (resilience/elastic.py): replica loss →
     # survivor submesh + cross-topology state reshard. Carries old/new
     # world size plus path taken ("mirror"/"checkpoint"), seconds lost,
-    # and steps replayed; rendered by experiments/obs_report.py.
+    # and steps replayed; multi-axis meshes additionally ride ``axis``
+    # ("data"/"stage") and ``old_shape``/``new_shape`` ([D, S] lists) as
+    # extras — no schema bump, extras are always legal — so a stage
+    # re-partition is attributable; rendered by experiments/obs_report.py.
     "remesh": ("old_world", "new_world"),
     # Serving request lifecycle (serving/scheduler.py, schema v2). ``req``
     # is the request id threading all four together. Enqueue carries the

@@ -1253,6 +1253,10 @@ def train_llm_dp(model_cfg: Optional[LlamaConfig] = None,
         if any(s > 1 for a, s in mesh.shape.items() if a != "data"):
             raise ValueError("elastic mode supports data-axis-only meshes "
                              f"(got {dict(mesh.shape)})")
+        # Pin the init params to host memory (see the PP elastic path):
+        # device_put can alias a compatibly-placed leaf into the first
+        # build's donated state, deleting the buffer a rebuild needs.
+        params = jax.tree.map(np.asarray, params)
 
         def _build_elastic(m):
             """(template_state, raw window step, window shard fn) on an
@@ -1510,6 +1514,8 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                  sink_every: int = 10,
                  resilience: Optional[ResilienceConfig] = None,
                  fault_plan=None,
+                 scale_hook=None,
+                 on_checkpoint=None,
                  telemetry=None) -> LLMTrainReport:
     """Pipeline(-x-data)-parallel tiny-Llama training; returns losses and
     throughput.
@@ -1542,10 +1548,23 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
       (pp.make_pp_numerics — block groups stage-qualified, losses bitwise
       on/off).
 
+    Elastic mode (``resilience.elastic=True``) now composes here: a
+    ``device_loss`` on the DP×PP mesh drains at the chunk edge and
+    re-meshes — dropping the victims' data rows whole when a complete
+    row survives (pure reshard at the same stage count), else
+    RE-PARTITIONING layers over the survivors at the largest stage count
+    dividing ``n_layers`` (``pp.repartition_stage_state`` rewrites the
+    ``(data, stage)`` ZeRO-1/EF stacks through topology-invariant
+    coordinate ids). ``device_return`` grows back toward the original
+    ``(D, S)`` factorization via pool-order rejoin. Named non-composing
+    combinations: ``schedule="interleaved"`` (the chunk-major layer
+    order breaks the blocked stage slices a re-partition re-slices) and
+    ``numerics_every`` (as on the DP trainer).
+
     Still DP-trainer-only (hard errors): hierarchical DCN tiers
     (``dcn``/``wire_dcn`` — the PP mesh has no two-level data tier),
-    elastic mode, the fused in-jit guard, and ``accum_steps`` (the
-    pipeline schedule owns its microbatching).
+    the fused in-jit guard, and ``accum_steps`` (the pipeline schedule
+    owns its microbatching).
 
     ``checkpoint_dir`` enables orbax checkpoint/resume with stream replay,
     the same contract as train_llm_dp: restore the latest step (sharding-
@@ -1594,10 +1613,20 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         raise ValueError(
             "PP zero1 routes the data-axis sync through the ring driver: "
             "set overlap_microbatches >= 1")
-    if resilience is not None and resilience.elastic:
-        raise ValueError("elastic mode is DP-trainer-only: losing a replica "
-                         "from a PP mesh orphans its stage partners — a "
-                         "re-wiring problem, not a resharding one")
+    elastic = bool(resilience is not None and resilience.elastic)
+    if elastic and schedule == "interleaved":
+        raise ValueError(
+            "elastic mode does not compose with schedule='interleaved': a "
+            "stage re-partition re-slices the blocked [n_layers/S] stage "
+            "shards, and the interleaved chunk-major layer order breaks "
+            "that contiguity — use schedule='gpipe' or '1f1b'")
+    if elastic and train_cfg.numerics_every > 0:
+        raise ValueError("numerics_every does not compose with elastic "
+                         "mode yet")
+    if scale_hook is not None and not elastic:
+        raise ValueError("scale_hook requires resilience.elastic=True — "
+                         "capacity changes ride the elastic re-mesh "
+                         "machinery")
     if resilience is not None and resilience.injit_guard:
         raise ValueError("injit_guard is not fused into the pipeline step "
                          "bodies — use the host StepGuard "
@@ -1620,7 +1649,55 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
         # per data shard there — the compress.py rule).
         numerics = pp.make_pp_numerics(params, mesh, psum_data=ovl >= 1)
 
-    if ovl >= 1:
+    window_shard = None
+    if elastic:
+        # Pin the init params to host memory: ``jax.device_put`` may
+        # alias (not copy) an already-compatibly-placed leaf into the
+        # first build's state, and the donated dispatches then delete
+        # that buffer — a post-remesh rebuild reading the closure would
+        # hit "Array has been deleted". Host arrays are never donated.
+        params = jax.tree.map(np.asarray, params)
+
+        def _build_elastic(m):
+            """(template_state, raw window step, window shard fn) on an
+            arbitrary (data, stage) mesh — initial build AND post-remesh
+            rebuild (including at a re-partitioned stage count) go through
+            here, so the two cannot drift."""
+            if ovl >= 1:
+                st, fn = pp.make_pipeline_overlap_multi_step(
+                    model_cfg, optimizer, m, params,
+                    n_microbatches=train_cfg.microbatches,
+                    schedule=schedule, aggregation=aggregation,
+                    wire=train_cfg.wire, overlap_microbatches=ovl,
+                    comm_buckets=cb)
+            else:
+                st = pp.init_state(m, params, optimizer)
+                fn = pp.make_pipeline_multi_step(
+                    model_cfg, optimizer, m,
+                    n_microbatches=train_cfg.microbatches,
+                    schedule=schedule)
+            # Per-(re)build CompileWatch, tagged with the (D, S)
+            # factorization: zero retraces per topology is the elastic
+            # PP compile bar (tests/test_elastic.py), and the tag is what
+            # makes a re-partition's recompile attributable in the event
+            # stream.
+            fn = introspect.watch(
+                fn, name=f"train/pp-{schedule}-elastic"
+                         + (f"-{aggregation}" if aggregation != "gradient"
+                            else "")
+                         + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+                         + (f"-b{cb}" if cb > 1 else "")
+                         + f"-d{m.shape['data']}s{m.shape['stage']}",
+                max_caches=None,
+                events=(telemetry.events if telemetry is not None
+                        else None),
+                meta={"steps_per_dispatch": spd},
+                meta_fn=lambda st, w: {"steps_per_dispatch":
+                                       int(w.shape[0])})
+            return st, fn, (lambda w, m=m: pp.shard_batch_window(m, w))
+
+        state, step_fn, window_shard = _build_elastic(mesh)
+    elif ovl >= 1:
         # DP×PP data-axis composition (pp.make_pipeline_overlap_*): the
         # cross-stage-reduced gradient's data sync rides the
         # compressed/overlapped ring; zero1 moments + EF residuals live
@@ -1649,20 +1726,24 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     # chunked mode legitimately compiles a tail-chunk shape, so no budget
     # there — but every compile event is stamped with the COMPILING
     # call's actual window size, so per-step MFU normalization
-    # (slo_monitor) stays honest for ragged tails.
-    step_fn = introspect.watch(
-        step_fn,
-        name=f"train/pp-{schedule}"
-             + (f"-{aggregation}" if aggregation != "gradient" else "")
-             + (f"-k{spd}" if spd > 1 else "")
-             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
-             + (f"-b{cb}" if cb > 1 else ""),
-        max_caches=(1 if spd == 1 else None),
-        events=(telemetry.events if telemetry is not None else None),
-        meta={"steps_per_dispatch": spd},
-        meta_fn=(None if spd == 1 else
-                 (lambda st, w: {"steps_per_dispatch": int(w.shape[0])})))
-    compile_watch = step_fn
+    # (slo_monitor) stays honest for ragged tails. The elastic path wraps
+    # inside _build_elastic instead (each re-mesh rebuild gets its own
+    # topology-tagged watch).
+    if not elastic:
+        step_fn = introspect.watch(
+            step_fn,
+            name=f"train/pp-{schedule}"
+                 + (f"-{aggregation}" if aggregation != "gradient" else "")
+                 + (f"-k{spd}" if spd > 1 else "")
+                 + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+                 + (f"-b{cb}" if cb > 1 else ""),
+            max_caches=(1 if spd == 1 else None),
+            events=(telemetry.events if telemetry is not None else None),
+            meta={"steps_per_dispatch": spd},
+            meta_fn=(None if spd == 1 else
+                     (lambda st, w: {"steps_per_dispatch":
+                                     int(w.shape[0])})))
+    compile_watch = step_fn if not elastic else None
 
     stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
@@ -1673,12 +1754,41 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
     _emit_manifest(telemetry, trainer="pp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
                    step_fn=step_fn, state=state, n_data=n_data,
-                   steps_per_dispatch=spd,
+                   steps_per_dispatch=spd, windowed=elastic,
                    overlap_microbatches=max(1, ovl))
+    if fault_plan is None and resilience is not None and resilience.faults:
+        fault_plan = resilience.fault_plan()   # resolve ONCE: the elastic
+        #   rebuild must re-wrap the same schedule, not a fresh counter's
+
+    def _make_batches(n):
+        return sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
+                               n, shard_skip=5000, seed=train_cfg.seed)
+
+    if elastic:
+        from ..resilience.elastic import ElasticController
+
+        def _rewrap(fn, start=0):
+            return _apply_resilience(fn, resilience, fault_plan, ckpt,
+                                     stats, start=start)
+
+        controller = ElasticController(
+            mesh, build=_build_elastic, rewrap=_rewrap,
+            make_batches=_make_batches, ckpt=ckpt,
+            mirror_every=resilience.mirror_every,
+            layer_divisor=model_cfg.n_layers, stats=stats,
+            telemetry=telemetry, log_fn=log_fn)
+        return _run_elastic_loop(
+            controller, _rewrap(step_fn), state, _make_batches(n_data),
+            train_cfg, n_data=n_data, start_step=start_step, ckpt=ckpt,
+            checkpoint_every=checkpoint_every, loss_sink=loss_sink,
+            sink_every=sink_every, log_every=log_every, log_fn=log_fn,
+            warmup_steps_excluded=warmup_steps_excluded, stats=stats,
+            telemetry=telemetry, steps_per_dispatch=spd,
+            window_shard_fn=window_shard, on_checkpoint=on_checkpoint,
+            scale_hook=scale_hook)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
-    batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
-                              n_data, shard_skip=5000, seed=train_cfg.seed)
+    batches = _make_batches(n_data)
     return _run_loop(step_fn, state, batches, train_cfg,
                      lambda b: pp.shard_batch(mesh, b), n_data=n_data,
                      start_step=start_step, ckpt=ckpt,
@@ -1691,7 +1801,8 @@ def train_llm_pp(model_cfg: Optional[LlamaConfig] = None,
                      window_shard_fn=lambda w: pp.shard_batch_window(mesh, w),
                      numerics=numerics,
                      numerics_every=train_cfg.numerics_every,
-                     compile_watch=compile_watch)
+                     compile_watch=compile_watch,
+                     on_checkpoint=on_checkpoint)
 
 
 def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
@@ -1708,6 +1819,8 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
                  sink_every: int = 10,
                  resilience: Optional[ResilienceConfig] = None,
                  fault_plan=None,
+                 scale_hook=None,
+                 on_checkpoint=None,
                  telemetry=None) -> LLMTrainReport:
     """Tensor(-x-data)-parallel tiny-Llama training; returns losses and
     throughput.
@@ -1736,9 +1849,21 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
       are model-axis psum-agreed (tp.make_tp_numerics — every shard
       carries the same summary; losses bitwise on/off).
 
-    Still DP-trainer-only (hard errors): hierarchical DCN tiers, elastic
-    mode (which would also need EF-residual resizing for the PSA
-    activation trees), the fused in-jit guard, and ``accum_steps``.
+    Elastic mode (``resilience.elastic=True``) composes with the fused
+    dispatch paths (``overlap_microbatches == 0``), INCLUDING
+    ``psa="int8_ef"`` — the ROADMAP 7a lift: a data-axis re-mesh resizes
+    the ``TPActState`` activation EF residual tree by the per-data-row
+    rule (``dp._resize_act_residual``; surviving rows copy bitwise, new
+    rows start at zero pending error), so preempt → remesh → resume under
+    PSA is bitwise. The model axis itself never re-meshes (a model-axis
+    device loss is unrecoverable — the Megatron layout is not
+    layer-sliced), and the DP×TP ring drivers
+    (``overlap_microbatches >= 1``) remain a named unsupported
+    combination (their ``(data, model)`` ring stacks have no reshard
+    rule yet).
+
+    Still DP-trainer-only (hard errors): hierarchical DCN tiers, the
+    fused in-jit guard, and ``accum_steps``.
     ``checkpoint_dir`` enables orbax checkpoint/resume with stream
     replay, the shared _run_loop contract — PSA EF residuals and ring
     residuals live in the state tree, so preempt/resume is bitwise.
@@ -1782,12 +1907,21 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
         raise ValueError(
             "TP zero1 routes the data-axis sync through the ring driver: "
             "set overlap_microbatches >= 1")
-    if resilience is not None and resilience.elastic:
+    elastic = bool(resilience is not None and resilience.elastic)
+    if elastic and ovl >= 1:
         raise ValueError(
-            "elastic mode is DP-trainer-only: re-meshing a TP run "
-            "re-shards the Megatron column/row layout, and PSA's "
-            "activation EF residual trees would need resizing "
-            "(deferred — a named unsupported combination)")
+            "elastic mode does not compose with the DP×TP ring driver "
+            "(overlap_microbatches >= 1): its (data, model)-sharded ring "
+            "stacks have no cross-topology reshard rule yet — set "
+            "overlap_microbatches=0 (the fused dispatch paths, including "
+            "psa='int8_ef', are elastic)")
+    if elastic and train_cfg.numerics_every > 0:
+        raise ValueError("numerics_every does not compose with elastic "
+                         "mode yet")
+    if scale_hook is not None and not elastic:
+        raise ValueError("scale_hook requires resilience.elastic=True — "
+                         "capacity changes ride the elastic re-mesh "
+                         "machinery")
     if resilience is not None and resilience.injit_guard:
         raise ValueError("injit_guard is not fused into the TP step "
                          "bodies — use the host StepGuard "
@@ -1811,7 +1945,38 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
         # compress.py rule).
         numerics = tp.make_tp_numerics(params, mesh, psum_data=ovl >= 1)
 
-    if ovl >= 1:
+    window_shard = None
+    if elastic:
+        # Pin the init params to host memory (see the PP elastic path):
+        # device_put can alias a compatibly-placed leaf into the first
+        # build's donated state, deleting the buffer a rebuild needs.
+        params = jax.tree.map(np.asarray, params)
+
+        def _build_elastic(m):
+            """(template_state, raw window step, window shard fn) on an
+            arbitrary (data, model) mesh — initial build AND post-remesh
+            rebuild (data row-drop / grow; the model axis never re-meshes)
+            go through here, so the two cannot drift."""
+            st, fn = tp.make_tp_multi_step(
+                model_cfg, optimizer, m, params, psa=psa,
+                batch_shape=(train_cfg.batch_size, train_cfg.seq_len))
+            # Per-(re)build CompileWatch, tagged with the (D, TP)
+            # factorization: zero retraces per topology is the elastic
+            # compile bar (tests/test_elastic.py).
+            fn = introspect.watch(
+                fn, name="train/tp-elastic"
+                         + (f"-psa-{psa.replace(':', '')}" if psa else "")
+                         + f"-d{m.shape['data']}x{m.shape['model']}",
+                max_caches=None,
+                events=(telemetry.events if telemetry is not None
+                        else None),
+                meta={"steps_per_dispatch": spd},
+                meta_fn=lambda st, w: {"steps_per_dispatch":
+                                       int(w.shape[0])})
+            return st, fn, (lambda w, m=m: tp.shard_batch_window(m, w))
+
+        state, step_fn, window_shard = _build_elastic(mesh)
+    elif ovl >= 1:
         # DP×TP data-axis composition (tp.make_tp_overlap_*): the
         # model-psum-reduced gradient's data sync rides the compressed/
         # overlapped ring; zero1 moments + EF residuals sharded
@@ -1832,21 +1997,25 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
             numerics=numerics)
     # Compile/retrace accounting: the same contract as the DP/PP trainers
     # — per-step mode promises ONE compiled program; chunked mode stamps
-    # every compile event with the COMPILING call's window size.
-    step_fn = introspect.watch(
-        step_fn,
-        name="train/tp"
-             + (f"-psa-{psa.replace(':', '')}" if psa else "")
-             + (f"-{aggregation}" if aggregation != "gradient" else "")
-             + (f"-k{spd}" if spd > 1 else "")
-             + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
-             + (f"-b{cb}" if cb > 1 else ""),
-        max_caches=(1 if spd == 1 else None),
-        events=(telemetry.events if telemetry is not None else None),
-        meta={"steps_per_dispatch": spd},
-        meta_fn=(None if spd == 1 else
-                 (lambda st, w: {"steps_per_dispatch": int(w.shape[0])})))
-    compile_watch = step_fn
+    # every compile event with the COMPILING call's window size. The
+    # elastic path wraps inside _build_elastic instead (each re-mesh
+    # rebuild gets its own topology-tagged watch).
+    if not elastic:
+        step_fn = introspect.watch(
+            step_fn,
+            name="train/tp"
+                 + (f"-psa-{psa.replace(':', '')}" if psa else "")
+                 + (f"-{aggregation}" if aggregation != "gradient" else "")
+                 + (f"-k{spd}" if spd > 1 else "")
+                 + (f"-ring{train_cfg.wire}-m{ovl}" if ovl else "")
+                 + (f"-b{cb}" if cb > 1 else ""),
+            max_caches=(1 if spd == 1 else None),
+            events=(telemetry.events if telemetry is not None else None),
+            meta={"steps_per_dispatch": spd},
+            meta_fn=(None if spd == 1 else
+                     (lambda st, w: {"steps_per_dispatch":
+                                     int(w.shape[0])})))
+    compile_watch = step_fn if not elastic else None
 
     stats = ResilienceStats()
     ckpt, state, start_step, done = _setup_checkpoint(
@@ -1857,12 +2026,43 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
     _emit_manifest(telemetry, trainer="tp", model_cfg=model_cfg,
                    train_cfg=train_cfg, mesh=mesh, start_step=start_step,
                    step_fn=step_fn, state=state, n_data=n_data,
-                   steps_per_dispatch=spd,
+                   steps_per_dispatch=spd, windowed=elastic,
                    overlap_microbatches=max(1, ovl))
+    if fault_plan is None and resilience is not None and resilience.faults:
+        fault_plan = resilience.fault_plan()   # resolve ONCE: the elastic
+        #   rebuild must re-wrap the same schedule, not a fresh counter's
+
+    def _make_batches(n):
+        return sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
+                               n, shard_skip=5000, seed=train_cfg.seed)
+
+    if elastic:
+        from ..resilience.elastic import ElasticController
+
+        def _rewrap(fn, start=0):
+            return _apply_resilience(fn, resilience, fault_plan, ckpt,
+                                     stats, start=start)
+
+        # No layer_divisor: the TP model axis never re-partitions —
+        # survivor_submesh either drops whole data rows or declares a
+        # model-axis loss unrecoverable.
+        controller = ElasticController(
+            mesh, build=_build_elastic, rewrap=_rewrap,
+            make_batches=_make_batches, ckpt=ckpt,
+            mirror_every=resilience.mirror_every, stats=stats,
+            telemetry=telemetry, log_fn=log_fn)
+        return _run_elastic_loop(
+            controller, _rewrap(step_fn), state, _make_batches(n_data),
+            train_cfg, n_data=n_data, start_step=start_step, ckpt=ckpt,
+            checkpoint_every=checkpoint_every, loss_sink=loss_sink,
+            sink_every=sink_every, log_every=log_every, log_fn=log_fn,
+            warmup_steps_excluded=warmup_steps_excluded, stats=stats,
+            telemetry=telemetry, steps_per_dispatch=spd,
+            window_shard_fn=window_shard, on_checkpoint=on_checkpoint,
+            scale_hook=scale_hook)
     step_fn = _apply_resilience(step_fn, resilience, fault_plan, ckpt, stats)
 
-    batches = sharded_batches(tok, train_cfg.batch_size, train_cfg.seq_len,
-                              n_data, shard_skip=5000, seed=train_cfg.seed)
+    batches = _make_batches(n_data)
     return _run_loop(step_fn, state, batches, train_cfg,
                      lambda b: tp.shard_batch(mesh, b), n_data=n_data,
                      start_step=start_step, ckpt=ckpt,
@@ -1875,4 +2075,5 @@ def train_llm_tp(model_cfg: Optional[LlamaConfig] = None,
                      window_shard_fn=lambda w: tp.shard_batch_window(mesh, w),
                      numerics=numerics,
                      numerics_every=train_cfg.numerics_every,
-                     compile_watch=compile_watch)
+                     compile_watch=compile_watch,
+                     on_checkpoint=on_checkpoint)

@@ -11,15 +11,24 @@ non-elastic trajectory. A fourth leg drives the BIDIRECTIONAL path
 grow rejoins the exact device the shrink lost (pool-order restore), and
 the post-grow losses must be bitwise a fresh 4-replica run restored from
 the grow recovery point — scale-UP holds the same standard as shrink.
+
+Two DP×PP legs prove the multi-axis tentpole (ISSUE 20): a 2×2 mesh
+loses one device and the controller drops the victim's DATA row (pure
+reshard, 2×2 → 1×2 on the data axis); a 1×4 mesh loses one device —
+no data row survives whole — and the controller RE-PARTITIONS layers
+onto fewer stages (1×4 → 1×2 on the stage axis, blocks re-sliced by
+global coordinate id), with the post-re-partition losses bitwise a
+fresh 1×2 run restored from the recovery checkpoint.
+
 Recovery time, steps replayed, and post-remesh throughput land in a JSON
 artifact (with ``rows`` that experiments/bench_compare.py judges
-lower-is-better); the telemetry JSONL (with its ``remesh`` events) is
-written next to it.
+lower-is-better, tagged per recovery axis); the telemetry JSONL (with
+its ``remesh`` events) is written next to it.
 
     python -m experiments.elastic_smoke --out elastic-recovery.json \
         --telemetry-dir elastic-telemetry
 
-Exit code 0 only when all three bitwise checks hold.
+Exit code 0 only when all the bitwise checks hold.
 """
 
 from __future__ import annotations
@@ -44,7 +53,7 @@ def run(out_path: str, telemetry_dir: str = None, iters: int = 8) -> int:
     from ddl25spring_tpu.parallel import make_mesh
     from ddl25spring_tpu.telemetry import Telemetry
     from ddl25spring_tpu.tokenizers import ByteTokenizer
-    from ddl25spring_tpu.train.llm import train_llm_dp
+    from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     # dmodel=20 on purpose: 23260 params make the 4-way and 3-way ZeRO-1
     # padded lengths differ, so the shrink genuinely swaps the pad
@@ -122,16 +131,63 @@ def run(out_path: str, telemetry_dir: str = None, iters: int = 8) -> int:
             round_trip_bitwise = (ref4g.start_step == g
                                   and rt.losses[g:] == ref4g.losses)
 
+        # 5./6. DP×PP legs (ISSUE 20): the same device_loss against the
+        # two survivor topologies. n_layers=4 so a stage re-partition has
+        # a divisor to land on (4 -> 2).
+        tiny4 = tiny.replace(n_layers=4)
+
+        def train_pp(d, s, *, ckpt=None, res=None):
+            return train_llm_pp(
+                tiny4, TrainConfig(**base, data=d, stage=s, microbatches=2),
+                mesh=make_mesh({"data": d, "stage": s},
+                               devices=jax.devices()[:d * s]),
+                tokenizer=ByteTokenizer(), log_every=0,
+                checkpoint_dir=ckpt, checkpoint_every=1000, resilience=res)
+
+        # 2×2, one device lost: the victim's stage column has a surviving
+        # replica, so the controller drops the DATA row — same stage
+        # count, pure reshard.
+        pp_d = train_pp(2, 2, res=ResilienceConfig(
+            elastic=True, faults="device_loss@2"))
+        pp_data = pp_d.remeshes[0] if pp_d.remeshes else None
+        pp_data_ok = bool(
+            pp_data is not None and pp_data["axis"] == "data"
+            and pp_data["old_shape"] == [2, 2]
+            and pp_data["new_shape"] == [1, 2]
+            and np.isfinite(pp_d.losses).all())
+
+        # 1×4, one device lost: no whole data row survives, so layers
+        # RE-PARTITION 4 -> 2 stages; acceptance is the same bitwise bar
+        # as the DP shrink — a fresh 1×2 run restored from the recovery
+        # checkpoint walks identical post-re-partition floats.
+        pp_s = train_pp(1, 4, ckpt=os.path.join(work, "pp"),
+                        res=ResilienceConfig(elastic=True,
+                                             faults="device_loss@2"))
+        pp_stage = pp_s.remeshes[0] if pp_s.remeshes else None
+        pp_stage_bitwise = False
+        if (pp_stage is not None and pp_stage["axis"] == "stage"
+                and pp_stage["new_shape"] == [1, 2]):
+            m2 = pp_stage["resume_step"]
+            pp_cmp = os.path.join(work, "pp-cmp")
+            prune_to(os.path.join(work, "pp"), pp_cmp, m2)
+            ref_pp = train_pp(1, 2, ckpt=pp_cmp)
+            pp_stage_bitwise = (ref_pp.start_step == m2
+                                and pp_s.losses[m2:] == ref_pp.losses)
+
         ok = bool(zero_fault_bitwise and post_remesh_bitwise
-                  and round_trip_bitwise and rec is not None)
+                  and round_trip_bitwise and rec is not None
+                  and pp_data_ok and pp_stage_bitwise)
         result = {
             "ok": ok,
             "iters": iters,
             "zero_fault_bitwise": bool(zero_fault_bitwise),
             "post_remesh_bitwise": bool(post_remesh_bitwise),
             "round_trip_bitwise": bool(round_trip_bitwise),
+            "pp_data_shrink_ok": pp_data_ok,
+            "pp_stage_repartition_bitwise": bool(pp_stage_bitwise),
             "remesh": rec,
             "round_trip_remeshes": rt.remeshes,
+            "pp_remeshes": [r for r in (pp_data, pp_stage) if r],
             "recovery_s": rec["seconds"] if rec else None,
             "steps_replayed": rec["steps_replayed"] if rec else None,
             "tokens_per_sec": el.tokens_per_sec,
@@ -155,6 +211,21 @@ def run(out_path: str, telemetry_dir: str = None, iters: int = 8) -> int:
                 {"metric": "steps_replayed_grow",
                  "value": (float(rt_grow["steps_replayed"])
                            if rt_grow else 0.0),
+                 "platform": "cpu", "variant": "elastic-smoke"},
+                # DP×PP recoveries, tagged by the axis that moved.
+                {"metric": "remesh_seconds_pp_data",
+                 "value": pp_data["seconds"] if pp_data else 0.0,
+                 "platform": "cpu", "variant": "elastic-smoke"},
+                {"metric": "steps_replayed_pp_data",
+                 "value": (float(pp_data["steps_replayed"])
+                           if pp_data else 0.0),
+                 "platform": "cpu", "variant": "elastic-smoke"},
+                {"metric": "remesh_seconds_pp_stage",
+                 "value": pp_stage["seconds"] if pp_stage else 0.0,
+                 "platform": "cpu", "variant": "elastic-smoke"},
+                {"metric": "steps_replayed_pp_stage",
+                 "value": (float(pp_stage["steps_replayed"])
+                           if pp_stage else 0.0),
                  "platform": "cpu", "variant": "elastic-smoke"},
             ],
         }

@@ -44,15 +44,16 @@ def _run_config(name: str, iters: int, sink, provenance: str,
     from ddl25spring_tpu.train.llm import train_llm_dp, train_llm_pp
 
     topo = CONFIGS[name]
-    if topo["stage"] > 1 and (elastic or dcn > 1 or wire_dcn):
-        # Still DP-trainer-only: elastic recovery (losing a replica from a
-        # PP mesh orphans its stage partners) and the hierarchical DCN
-        # tiers (the PP mesh has no two-level data axis). Everything else
-        # — --steps-per-dispatch, --zero1, --wire, --overlap-microbatches,
-        # --numerics-every — now composes on PP configs too (the PR 14
-        # column: pp.make_pipeline_multi_step / make_pipeline_overlap_*).
-        raise ValueError(f"--elastic/--dcn/--wire-dcn need a DP config "
-                         f"(got {name})")
+    if topo["stage"] > 1 and (dcn > 1 or wire_dcn):
+        # Still DP-trainer-only: the hierarchical DCN tiers (the PP mesh
+        # has no two-level data axis). Everything else —
+        # --steps-per-dispatch, --zero1, --wire, --overlap-microbatches,
+        # --numerics-every, and now --elastic (ISSUE 20: a stage loss
+        # re-partitions layers onto fewer stages; a loss with a surviving
+        # stage column drops the data row) — composes on PP configs too.
+        # --elastic × --numerics-every on any config stays a named error
+        # (train_llm_pp/dp raise it).
+        raise ValueError(f"--dcn/--wire-dcn need a DP config (got {name})")
     train_cfg = TrainConfig(iters=iters, steps_per_dispatch=steps_per_dispatch,
                             numerics_every=numerics_every, wire=wire,
                             overlap_microbatches=overlap_microbatches,
@@ -127,8 +128,13 @@ def _run_config(name: str, iters: int, sink, provenance: str,
               f"{ {k: v for k, v in report.resilience.as_dict().items() if v} }",
               flush=True)
     for rec in report.remeshes:
-        print(f"{name}: remesh {rec['old_world']} -> {rec['new_world']} "
-              f"via {rec['path']} in {rec['seconds']:.3f}s "
+        topo_note = ""
+        if rec.get("old_shape") and rec.get("new_shape"):
+            topo_note = (f" [{rec['old_shape'][0]}x{rec['old_shape'][1]} -> "
+                         f"{rec['new_shape'][0]}x{rec['new_shape'][1]} on "
+                         f"the {rec.get('axis', 'data')} axis]")
+        print(f"{name}: remesh {rec['old_world']} -> {rec['new_world']}"
+              f"{topo_note} via {rec['path']} in {rec['seconds']:.3f}s "
               f"({rec['steps_replayed']} steps replayed)", flush=True)
     if not report.losses:
         return {}  # resumed past the end; nothing new to record
@@ -286,11 +292,13 @@ if __name__ == "__main__":
                          "hierarchical collectives (int8_ef = the "
                          "compress-where-scarce headline)")
     ap.add_argument("--elastic", action="store_true",
-                    help="elastic DP (resilience/elastic.py): survive "
-                         "replica loss (inject with --faults "
+                    help="elastic recovery (resilience/elastic.py): "
+                         "survive device loss (inject with --faults "
                          "'device_loss@K') by re-meshing onto the "
-                         "survivors and resharding params + ZeRO-1 state; "
-                         "DP configs only")
+                         "survivors and resharding state; on PP configs "
+                         "a loss with a surviving stage column drops the "
+                         "data row, otherwise layers re-partition onto "
+                         "fewer stages")
     a = ap.parse_args()
     if a.cpu:
         from ._cpu_pin import pin_cpu_virtual

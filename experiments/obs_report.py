@@ -523,10 +523,22 @@ def report_run(events: list, heartbeat_path: str = None) -> None:
         _section("remesh (elastic recoveries)")
         for e in remeshes:
             lost = e.get("lost")
+            # Multi-axis meshes (DP×PP) tag each remesh with the axis that
+            # moved and the (D, S) factorization old -> new; a "stage"
+            # axis means a layer re-partition (state re-sliced by
+            # coordinate id), "data" a pure row-drop/grow reshard.
+            old_s, new_s = e.get("old_shape"), e.get("new_shape")
+            topo = ""
+            if old_s and new_s:
+                axis = e.get("axis", "data")
+                kind = ("re-partition" if axis == "stage" else "reshard")
+                topo = (f"  [{old_s[0]}x{old_s[1]} -> "
+                        f"{new_s[0]}x{new_s[1]}, {axis} axis: {kind}]")
             print(f"  step {e.get('it', '?'):>6}: "
                   f"{e.get('old_world', '?')} -> {e.get('new_world', '?')} "
-                  f"replicas"
+                  f"devices"
                   + (f" (lost {lost})" if lost else "")
+                  + topo
                   + f"  via {e.get('path', '?')}"
                   + (f"  {e['seconds']:.3f}s lost"
                      if isinstance(e.get("seconds"), (int, float)) else "")

@@ -95,9 +95,34 @@ def test_survivor_submesh_drops_lost_replicas(devices):
         survivor_submesh(mesh, [0, 1, 2, 3])     # nobody left
     with pytest.raises(ValueError):
         survivor_submesh(mesh, [7])              # out of range
+    # Multi-axis scope (ISSUE 20): on a 2×2 DP×PP mesh a victim whose
+    # stage column has a surviving replica drops its whole DATA row —
+    # same stage count, the survivors keep flat (data-major) order.
     pp_mesh = make_mesh({"data": 2, "stage": 2}, devices=devices[:4])
-    with pytest.raises(ValueError):              # DP-only scope
-        survivor_submesh(pp_mesh, [0])
+    sub_pp = survivor_submesh(pp_mesh, [0])
+    assert dict(sub_pp.shape) == {"data": 1, "stage": 2}
+    assert list(sub_pp.devices.flatten()) == [devices[2], devices[3]]
+    # 1×4: no data row survives the loss, so the stage axis must
+    # RE-PARTITION — named error without layer_divisor, largest divisor
+    # that fits (4 -> 2 over 3 survivors) with it.
+    pp14 = make_mesh({"data": 1, "stage": 4}, devices=devices[:4])
+    with pytest.raises(ValueError, match="layer_divisor"):
+        survivor_submesh(pp14, [1])
+    sub14 = survivor_submesh(pp14, [1], layer_divisor=4)
+    assert dict(sub14.shape) == {"data": 1, "stage": 2}
+    assert list(sub14.devices.flatten()) == [devices[0], devices[2]]
+    # A model-axis mesh has no re-partition fallback: losing a whole
+    # data row's worth of TP shards is unrecoverable, by name.
+    tp_mesh = make_mesh({"data": 2, "model": 2}, devices=devices[:4])
+    sub_tp = survivor_submesh(tp_mesh, [3])
+    assert dict(sub_tp.shape) == {"data": 1, "model": 2}
+    with pytest.raises(ValueError, match="unrecoverable"):
+        survivor_submesh(make_mesh({"data": 1, "model": 4},
+                                   devices=devices[:4]), [1])
+    # 3-axis meshes stay out of elastic scope, by name.
+    with pytest.raises(ValueError, match="3-axis"):
+        survivor_submesh(make_mesh({"data": 2, "stage": 2, "model": 2},
+                                   devices=devices[:8]), [0])
 
 
 def test_device_loss_fault_parse_victims_deterministic():
@@ -387,9 +412,20 @@ def test_rejoin_mesh_restores_pool_order(devices):
         rejoin_mesh(sub, [devices[7]], pool=pool)
     with pytest.raises(ValueError):                     # nothing returned
         rejoin_mesh(sub, [], pool=pool)
-    pp_mesh = make_mesh({"data": 2, "stage": 2}, devices=devices[:4])
-    with pytest.raises(ValueError):                     # DP-only scope
-        rejoin_mesh(pp_mesh, [devices[4]])
+    # Multi-axis rejoin (ISSUE 20): a full-pool rejoin reshapes straight
+    # back into the ORIGINAL (data, stage) grid device-for-device; a
+    # partial rejoin re-runs the factorization choice (capped at the
+    # original stage count, needing layer_divisor).
+    pp_pool, pp_shape = devices[:4], (2, 2)
+    pp_mesh = make_mesh({"data": 2, "stage": 2}, devices=pp_pool)
+    pp_sub = survivor_submesh(pp_mesh, [2])             # 2×2 -> 1×2
+    back_pp = rejoin_mesh(pp_sub, [devices[2], devices[3]], pool=pp_pool,
+                          pool_shape=pp_shape, layer_divisor=4)
+    assert dict(back_pp.shape) == {"data": 2, "stage": 2}
+    assert list(back_pp.devices.flatten()) == list(pp_pool)
+    with pytest.raises(ValueError, match="layer_divisor"):
+        rejoin_mesh(pp_sub, [devices[2]], pool=pp_pool,
+                    pool_shape=pp_shape)                # partial, no divisor
 
 
 def test_device_return_parse_arrivals_deterministic():
@@ -672,3 +708,266 @@ def test_elastic_ring_int8_preempt_remesh_resume_bitwise(tmp_path, devices):
     assert not r2.preempted
     assert ref.losses[r2.start_step:] == r2.losses     # bitwise resume
     assert ref.losses[:r2.start_step] == r1.losses[:r2.start_step]
+
+
+# ------------------------------------- multi-axis elasticity (ISSUE 20)
+
+# n_layers=4 so a stage re-partition has somewhere to land (4 -> 2 -> 1
+# all divide); dmodel=20 keeps the differing-pad property of TINY.
+TINY4 = TINY.replace(n_layers=4)
+PP_BASE = dict(batch_size=2, seq_len=16, lr=3e-3, microbatches=2)
+
+
+def _pp_mesh(devices, d, s):
+    return make_mesh({"data": d, "stage": s}, devices=devices[:d * s])
+
+
+def _train_pp(devices, d, s, *, iters=8, tmp=None, name=None, spd=2,
+              agg="gradient", wire="fp32", ovl=0, cb=1, resilience=None,
+              checkpoint_every=1000, telemetry=None):
+    from ddl25spring_tpu.train.llm import train_llm_pp
+    return train_llm_pp(
+        TINY4,
+        TrainConfig(**PP_BASE, iters=iters, data=d, stage=s,
+                    steps_per_dispatch=spd, wire=wire,
+                    overlap_microbatches=ovl, comm_buckets=cb),
+        mesh=_pp_mesh(devices, d, s), tokenizer=ByteTokenizer(),
+        aggregation=agg, log_every=0, resilience=resilience,
+        checkpoint_dir=None if tmp is None else str(tmp / name),
+        checkpoint_every=checkpoint_every, telemetry=telemetry)
+
+
+@pytest.mark.parametrize("d,s,agg,ovl", [(2, 2, "gradient", 0),
+                                         (1, 4, "zero1", 1)])
+def test_elastic_pp_no_fault_bitwise_matches_non_elastic(devices, d, s,
+                                                         agg, ovl):
+    """Zero faults on a DP×PP mesh: the elastic window loop (recovery
+    machinery armed but idle) walks bitwise the same losses as the
+    non-elastic pipeline trainer, on both the plain and the ring/zero1
+    drivers."""
+    ref = _train_pp(devices, d, s, iters=6, agg=agg, ovl=ovl)
+    got = _train_pp(devices, d, s, iters=6, agg=agg, ovl=ovl,
+                    resilience=ResilienceConfig(elastic=True))
+    assert got.losses == ref.losses
+    assert got.remeshes == [] and got.resilience.remeshes == 0
+
+
+@pytest.mark.parametrize("mirror_every,ckpt_every,expect_path,expect_replay",
+                         [(1, 1000, "mirror", 0),
+                          (0, 4, "checkpoint", 2)])
+def test_elastic_pp_stage_repartition_bitwise(tmp_path, devices,
+                                              mirror_every, ckpt_every,
+                                              expect_path, expect_replay):
+    """The ISSUE 20 tentpole bar, re-partition direction: a device loss
+    on a 1×4 pipeline leaves no complete data row, so layers re-slice
+    onto 2 stages (blocks [1, ...] per stage -> [2, ...], moved by global
+    coordinate id) and training continues — with the post-re-partition
+    losses bitwise a fresh 1×2 run restored from the recovery state, on
+    both recovery paths."""
+    el = _train_pp(devices, 1, 4, iters=8, tmp=tmp_path, name="el",
+                   checkpoint_every=ckpt_every,
+                   resilience=ResilienceConfig(elastic=True,
+                                               mirror_every=mirror_every,
+                                               faults="device_loss@3"))
+    assert len(el.remeshes) == 1 and el.resilience.remeshes == 1
+    rec = el.remeshes[0]
+    assert rec["axis"] == "stage"
+    assert rec["old_shape"] == [1, 4] and rec["new_shape"] == [1, 2]
+    assert rec["old_world"] == 4 and rec["new_world"] == 2
+    assert rec["detected_at"] == 6 and rec["path"] == expect_path
+    assert rec["steps_replayed"] == expect_replay
+    assert rec["resume_step"] == 6 - expect_replay
+    assert len(el.losses) == 8 and np.isfinite(el.losses).all()
+
+    m = rec["resume_step"]
+    _prune_to(tmp_path, "el", "cmp", m)
+    ref2 = _train_pp(devices, 1, 2, iters=8, tmp=tmp_path, name="cmp")
+    assert ref2.start_step == m
+    assert el.losses[m:] == ref2.losses                # bitwise: same floats
+
+
+def test_elastic_pp_data_shrink_preferred_bitwise(tmp_path, devices):
+    """The reshard direction: a device loss on a 2×2 mesh whose stage
+    column still has a surviving replica drops the victim's DATA row —
+    stage count unchanged, the recovery is a pure reshard — and the
+    post-remesh losses are bitwise a fresh 1×2 run restored from the
+    recovery state."""
+    el = _train_pp(devices, 2, 2, iters=8, tmp=tmp_path, name="el",
+                   resilience=ResilienceConfig(elastic=True,
+                                               faults="device_loss@3"))
+    assert len(el.remeshes) == 1
+    rec = el.remeshes[0]
+    assert rec["axis"] == "data"
+    assert rec["old_shape"] == [2, 2] and rec["new_shape"] == [1, 2]
+    assert len(el.losses) == 8 and np.isfinite(el.losses).all()
+
+    m = rec["resume_step"]
+    _prune_to(tmp_path, "el", "cmp", m)
+    ref2 = _train_pp(devices, 1, 2, iters=8, tmp=tmp_path, name="cmp")
+    assert ref2.start_step == m
+    assert el.losses[m:] == ref2.losses
+
+
+@pytest.mark.parametrize("d,s,grow_axis", [(2, 2, "data"), (1, 4, "stage")])
+def test_elastic_pp_round_trip_restores_original_topology(tmp_path, devices,
+                                                          d, s, grow_axis):
+    """The multi-axis pool-order bar: device_loss then a full
+    device_return walks (D, S) -> (D', S') -> (D, S) — the grow rejoins
+    every absent pool slot and the full-pool reshape rebuilds the
+    ORIGINAL factorization (rejoin_mesh pool_shape), in both directions:
+    a data-row drop grows its row back, a stage re-partition grows back
+    to the original stage count. Post-grow losses are bitwise a fresh
+    (D, S) run restored from the grow recovery point."""
+    el = _train_pp(devices, d, s, iters=12, tmp=tmp_path, name="el",
+                   resilience=ResilienceConfig(
+                       elastic=True, mirror_every=1,
+                       faults="device_loss@2,device_return@5:3"))
+    assert [r["direction"] for r in el.remeshes] == ["shrink", "grow"]
+    shrink, grow = el.remeshes
+    assert shrink["old_shape"] == [d, s] and shrink["new_shape"] == [1, 2]
+    assert grow["axis"] == grow_axis
+    assert grow["old_shape"] == [1, 2] and grow["new_shape"] == [d, s]
+    assert grow["old_world"] == 2 and grow["new_world"] == 4
+    assert len(el.losses) == 12 and np.isfinite(el.losses).all()
+
+    m = grow["resume_step"]
+    _prune_to(tmp_path, "el", "cmp", m)
+    ref = _train_pp(devices, d, s, iters=12, tmp=tmp_path, name="cmp")
+    assert ref.start_step == m
+    assert el.losses[m:] == ref.losses                 # bitwise: same floats
+
+
+def test_elastic_pp_zero_retraces_per_topology(tmp_path, devices):
+    """Compile accounting across a re-partition: each topology's window
+    driver carries its own (D, S)-tagged CompileWatch, both tags appear
+    in the event stream, and NO compile event is a retrace — a topology
+    compiles its programs once and serves every subsequent dispatch from
+    cache."""
+    from ddl25spring_tpu.telemetry import Telemetry, read_events
+
+    tel = Telemetry(str(tmp_path / "obs"))
+    with tel:
+        got = _train_pp(devices, 1, 4, iters=8, telemetry=tel,
+                        resilience=ResilienceConfig(elastic=True,
+                                                    faults="device_loss@3"))
+    assert len(got.remeshes) == 1
+    compiles = {}
+    for e in read_events(tel.events_path):
+        if e.get("type") == "compile":
+            row = compiles.setdefault(e["name"],
+                                      {"compiles": 0, "retraces": 0})
+            row["compiles"] += 1
+            row["retraces"] += int(bool(e.get("retrace")))
+    assert "train/pp-gpipe-elastic-d1s4" in compiles
+    assert "train/pp-gpipe-elastic-d1s2" in compiles
+    assert all(v["retraces"] == 0 for v in compiles.values())
+    remesh = [e for e in read_events(tel.events_path)
+              if e.get("type") == "remesh"]
+    assert len(remesh) == 1
+    assert remesh[0]["axis"] == "stage"
+    assert remesh[0]["old_shape"] == [1, 4]
+    assert remesh[0]["new_shape"] == [1, 2]
+
+
+def test_elastic_pp_chaos_nan_grad_skip_and_stage_loss(devices):
+    """Chaos composition: one elastic 1×4 pipeline run takes BOTH a
+    nan_grad fault (StepGuard skips the poisoned dispatch — consumed,
+    not learned) and a later device loss (stage re-partition 4 -> 2);
+    the run finishes every iteration finite with both recoveries
+    recorded on their own counters."""
+    got = _train_pp(devices, 1, 4, iters=10,
+                    resilience=ResilienceConfig(
+                        elastic=True, guard=True,
+                        faults="nan_grad@1,device_loss@3"))
+    assert got.resilience.skipped_steps >= 1           # the guard fired
+    assert got.resilience.remeshes == 1                # and the re-mesh
+    assert got.remeshes[0]["axis"] == "stage"
+    # The poisoned dispatch's losses stay visible as NaN (the
+    # test_resilience.py contract: the fault is visible AND contained) —
+    # everything from the re-mesh step onward is finite.
+    assert len(got.losses) == 10
+    assert np.isfinite(got.losses[4:]).all()
+    assert sum(np.isfinite(l) for l in got.losses) >= 8
+
+
+def test_elastic_pp_rejects_interleaved_by_name(devices):
+    """The named non-composition: the interleaved schedule's chunk-major
+    layer order breaks the contiguous blocked stage slices a
+    re-partition re-slices — config-time error naming the fix."""
+    from ddl25spring_tpu.train.llm import train_llm_pp
+    with pytest.raises(ValueError, match="interleaved"):
+        train_llm_pp(
+            TINY4,
+            TrainConfig(**PP_BASE, iters=2, data=1, stage=2,
+                        steps_per_dispatch=2),
+            mesh=_pp_mesh(devices, 1, 2), tokenizer=ByteTokenizer(),
+            schedule="interleaved", log_every=0,
+            resilience=ResilienceConfig(elastic=True))
+
+
+# --------------------------------------- TP PSA elasticity (ROADMAP 7a)
+
+def _train_tp(devices, d, *, iters=8, tmp=None, name=None, spd=1,
+              psa="int8_ef", resilience=None, checkpoint_every=1000):
+    from ddl25spring_tpu.train.llm import train_llm_tp
+    return train_llm_tp(
+        TINY4,
+        TrainConfig(batch_size=2, seq_len=16, lr=3e-3, iters=iters,
+                    data=d, model=2, steps_per_dispatch=spd, psa=psa),
+        mesh=make_mesh({"data": d, "model": 2}, devices=devices[:d * 2]),
+        tokenizer=ByteTokenizer(), log_every=0, resilience=resilience,
+        checkpoint_dir=None if tmp is None else str(tmp / name),
+        checkpoint_every=checkpoint_every)
+
+
+def test_elastic_tp_psa_no_fault_bitwise(devices):
+    """The lifted PSA × elastic combination (ROADMAP 7a): with zero
+    faults the elastic TP loop under psa='int8_ef' is bitwise the
+    non-elastic trainer."""
+    ref = _train_tp(devices, 2, iters=4)
+    got = _train_tp(devices, 2, iters=4,
+                    resilience=ResilienceConfig(elastic=True))
+    assert got.losses == ref.losses and got.remeshes == []
+
+
+def test_elastic_tp_psa_int8_preempt_remesh_resume_bitwise(tmp_path,
+                                                           devices):
+    """ROADMAP 7a acceptance: preempt → remesh → resume under
+    psa='int8_ef' on a DP×TP mesh. A 2×2 run loses a device (data row
+    drop to 1×2 — the TPActState activation EF residual tree resized
+    per data row by dp._resize_act_residual), is preempted later, and
+    the rerun's stitched losses equal the same run without the
+    preemption EXACTLY — the PSA residuals survive both the reshard and
+    the save/restore cycle."""
+    ref = _train_tp(devices, 2, iters=8,
+                    resilience=ResilienceConfig(elastic=True, mirror_every=1,
+                                                faults="device_loss@2"))
+    assert len(ref.losses) == 8 and len(ref.remeshes) == 1
+    assert ref.remeshes[0]["axis"] == "data"
+    assert ref.remeshes[0]["old_shape"] == [2, 2]
+    assert ref.remeshes[0]["new_shape"] == [1, 2]
+
+    r1 = _train_tp(devices, 2, iters=8, tmp=tmp_path, name="pre",
+                   checkpoint_every=2,
+                   resilience=ResilienceConfig(
+                       elastic=True, mirror_every=1,
+                       faults="device_loss@2,preempt@5"))
+    assert r1.preempted and len(r1.losses) < 8
+    assert len(r1.remeshes) == 1
+
+    # Rerun at the post-shrink factorization: the saved layout is 1×2.
+    r2 = _train_tp(devices, 1, iters=8, tmp=tmp_path, name="pre",
+                   checkpoint_every=2)
+    assert not r2.preempted
+    assert ref.losses[r2.start_step:] == r2.losses     # bitwise resume
+    assert ref.losses[:r2.start_step] == r1.losses[:r2.start_step]
+
+
+def test_elastic_tp_model_axis_loss_is_fatal(devices):
+    """A 1×2 TP mesh losing a device has no surviving data row and no
+    re-partition fallback (the Megatron layout is not layer-sliced):
+    elastic mode must re-raise, not fabricate a topology."""
+    with pytest.raises(ReplicaLossError):
+        _train_tp(devices, 1, iters=4,
+                  resilience=ResilienceConfig(elastic=True,
+                                              faults="device_loss@1"))

@@ -385,9 +385,11 @@ def test_tp_psa_named_errors(devices):
 def test_train_llm_tp_rejects_unsupported_levers(devices):
     """The TP trainer's validation wall (the test_train_llm_pp_rejects_
     dp_only_levers precedent): every combination the docs list as
-    unsupported must hard-error at config time with a NAMED reason —
-    PSA × elastic in particular (the remesh path doesn't resize the
-    activation EF residual trees yet)."""
+    unsupported must hard-error at config time with a NAMED reason.
+    PSA × elastic is no longer on the list (the remesh path resizes the
+    activation EF residual trees now — tests/test_elastic.py); what
+    remains named-unsupported is elastic × the DP×TP ring driver and
+    elastic × numerics."""
     from ddl25spring_tpu.config import ResilienceConfig, TrainConfig
     from ddl25spring_tpu.tokenizers import ByteTokenizer
     from ddl25spring_tpu.train.llm import train_llm_tp
@@ -405,9 +407,17 @@ def test_train_llm_tp_rejects_unsupported_levers(devices):
         train_llm_tp(cfg, TrainConfig(**base, wire="int8_ef"), **kw)
     with pytest.raises(ValueError, match="ring driver"):
         train_llm_tp(cfg, TrainConfig(**base), aggregation="zero1", **kw)
-    with pytest.raises(ValueError, match="elastic"):
-        train_llm_tp(cfg, TrainConfig(**base, psa="int8_ef"),
+    with pytest.raises(ValueError, match="ring driver"):
+        train_llm_tp(cfg, TrainConfig(**base, overlap_microbatches=1),
+                     aggregation="zero1",
                      resilience=ResilienceConfig(elastic=True), **kw)
+    with pytest.raises(ValueError, match="numerics_every"):
+        train_llm_tp(cfg, TrainConfig(**base, psa="int8_ef",
+                                      numerics_every=1),
+                     resilience=ResilienceConfig(elastic=True), **kw)
+    with pytest.raises(ValueError, match="scale_hook"):
+        train_llm_tp(cfg, TrainConfig(**base),
+                     scale_hook=lambda *a: None, **kw)
     with pytest.raises(ValueError, match="injit_guard"):
         train_llm_tp(cfg, TrainConfig(**base),
                      resilience=ResilienceConfig(injit_guard=True), **kw)
